@@ -1,0 +1,35 @@
+"""machine_learning_apache_spark_tpu — a TPU-native ML framework.
+
+A brand-new JAX/XLA framework with the capabilities of the reference repo
+``Makkan13/Machine_Learning---Apache-Spark`` (Spark-launched PyTorch training),
+re-designed TPU-first:
+
+- ``session``   — Spark-session-equivalent run configuration layer (reference L0,
+  ``mllib_multilayer_perceptron_classifier.py:12-19``).
+- ``data``      — ingestion: libsvm reader, image/text dataset loaders, distributed
+  sampler, device-feeding loader (reference L1-L3).
+- ``text``      — tokenizer / vocab / transform chains (reference C13).
+- ``models``    — the model zoo: MLP, CNN, LSTM, encoder-decoder Transformer
+  (reference C2/C5/C8/C14-C23) as reusable Flax modules.
+- ``ops``       — attention core, masks, positional encodings, layer norm; Pallas
+  kernels for the hot paths.
+- ``parallel``  — mesh construction, data/tensor/sequence parallelism. The
+  reference's DDP-over-gloo (C11) becomes ``lax.pmean`` of grads over the mesh
+  axis ``"data"`` inside a compiled step.
+- ``train``     — losses, metrics, train state, fit/evaluate loops, timing spans
+  (reference L7, the loop machinery every script re-implements inline).
+- ``launcher``  — the TorchDistributor equivalent (reference C12): spawn one
+  process per host, rendezvous, run a function by reference, rank-0 result.
+- ``mllib``     — L-BFGS MLP baseline trainer + evaluator (reference C1 parity).
+- ``utils``     — prng, logging, checkpointing, profiling hooks.
+
+The package directory name is the importable form of the project name
+``machine_learning---apache-spark_tpu`` (dashes are not valid in Python
+identifiers).
+"""
+
+__version__ = "0.1.0"
+
+from machine_learning_apache_spark_tpu.session import Session, SessionBuilder
+
+__all__ = ["Session", "SessionBuilder", "__version__"]

@@ -1,0 +1,5 @@
+from machine_learning_apache_spark_tpu.data.frame import ArrayFrame
+from machine_learning_apache_spark_tpu.data.libsvm import read_libsvm, write_libsvm
+from machine_learning_apache_spark_tpu.data.reader import DataReader
+
+__all__ = ["ArrayFrame", "read_libsvm", "write_libsvm", "DataReader"]

@@ -1,0 +1,42 @@
+"""Spark-style reader: ``session.read.format("libsvm").load(path)``.
+
+Mirrors the ingestion call at ``mllib_multilayer_perceptron_classifier.py:22-23``.
+Supported formats: ``libsvm`` (dense ArrayFrame), ``npz`` (features/labels
+arrays saved by numpy), ``csv`` (last column = label).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from machine_learning_apache_spark_tpu.data.frame import ArrayFrame
+from machine_learning_apache_spark_tpu.data.libsvm import read_libsvm
+
+
+class DataReader:
+    def __init__(self, session: Any = None) -> None:
+        self._session = session
+        self._format = "libsvm"
+        self._options: dict[str, Any] = {}
+
+    def format(self, fmt: str) -> "DataReader":
+        self._format = fmt.lower()
+        return self
+
+    def option(self, key: str, value: Any) -> "DataReader":
+        self._options[key.lower()] = value
+        return self
+
+    def load(self, path: str) -> ArrayFrame:
+        if self._format == "libsvm":
+            nf = self._options.get("numfeatures")
+            return read_libsvm(path, num_features=int(nf) if nf else None)
+        if self._format == "npz":
+            data = np.load(path)
+            return ArrayFrame(data["features"], data["labels"])
+        if self._format == "csv":
+            raw = np.loadtxt(path, delimiter=",", dtype=np.float32)
+            return ArrayFrame(raw[:, :-1], raw[:, -1].astype(np.int64))
+        raise ValueError(f"unsupported format {self._format!r}")

@@ -1,0 +1,6 @@
+from machine_learning_apache_spark_tpu.launcher.coordinator import (
+    RendezvousSpec,
+    initialize_from_env,
+)
+
+__all__ = ["RendezvousSpec", "initialize_from_env"]

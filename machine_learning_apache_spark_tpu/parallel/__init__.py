@@ -1,0 +1,13 @@
+from machine_learning_apache_spark_tpu.parallel.mesh import (
+    make_mesh,
+    data_parallel_mesh,
+    batch_sharding,
+    replicated_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_parallel_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+]

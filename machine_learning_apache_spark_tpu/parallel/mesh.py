@@ -1,0 +1,100 @@
+"""Device-mesh construction.
+
+This is where the reference's distributed runtime (gloo process groups,
+``distributed_cnn.py:152``) maps onto TPU hardware: a ``jax.sharding.Mesh``
+over the slice, with collectives compiled into the step and riding ICI.
+
+Axis convention (used across the framework):
+
+- ``"data"``     — batch-sharded data parallelism (the reference's DDP, C11).
+- ``"model"``    — tensor parallelism (capability headroom; SURVEY.md §2.3).
+- ``"seq"``      — sequence/context parallelism for ring attention.
+- ``"pipeline"`` — pipeline stages.
+- ``"expert"``   — expert parallelism (MoE; unused by the zoo, reserved).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPELINE_AXIS = "pipeline"
+EXPERT_AXIS = "expert"
+
+_CANONICAL_ORDER = (DATA_AXIS, PIPELINE_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+def make_mesh(
+    axes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a mesh from an axis-name → size mapping.
+
+    Size ``0`` or ``-1`` on at most one axis means "all remaining devices".
+    With no axes given, returns a pure data-parallel mesh over every device.
+    Axes are laid out so the innermost (fastest-varying, best-ICI-locality)
+    axis is ``model``, then ``seq`` — tensor- and sequence-parallel
+    collectives are latency-bound and want nearest-neighbour links, while
+    data-parallel allreduce tolerates the outer axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {DATA_AXIS: n})
+
+    wildcard = [k for k, v in axes.items() if v in (0, -1)]
+    if len(wildcard) > 1:
+        raise ValueError(f"at most one wildcard axis, got {wildcard}")
+    fixed = math.prod(v for v in axes.values() if v not in (0, -1))
+    if wildcard:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
+        axes[wildcard[0]] = n // fixed
+    if math.prod(axes.values()) != n:
+        raise ValueError(f"mesh {axes} does not cover {n} devices")
+
+    names = sorted(
+        axes.keys(),
+        key=lambda a: _CANONICAL_ORDER.index(a) if a in _CANONICAL_ORDER else 0,
+    )
+    shape = tuple(axes[a] for a in names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def data_parallel_mesh(n: int | None = None) -> Mesh:
+    """The parity mesh: one axis ``"data"`` over n (default: all) devices —
+    the TPU form of the reference's N gloo ranks (SURVEY.md §2.4)."""
+    devices = jax.devices()[:n] if n else None
+    return make_mesh({DATA_AXIS: 0 if n is None else n}, devices=devices)
+
+
+def batch_sharding(mesh: Mesh, *, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for a batch-leading array: dim 0 split over the data axis —
+    the ``DistributedSampler`` partitioning (``distributed_cnn.py:112-119``)
+    expressed as a sharding instead of a sampler."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated (DDP keeps whole replicas of params on every rank —
+    ``DDP(model)`` at ``distributed_cnn.py:156``)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch, *, axis: str = DATA_AXIS):
+    """Place a host-local pytree of arrays onto the mesh, batch-dim sharded."""
+    sharding = batch_sharding(mesh, axis=axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(mesh: Mesh, tree):
+    sharding = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,8 @@
+from machine_learning_apache_spark_tpu.train.metrics import (
+    accuracy,
+    Mean,
+    Sum,
+    MetricBundle,
+)
+
+__all__ = ["accuracy", "Mean", "Sum", "MetricBundle"]

@@ -1,0 +1,72 @@
+"""Metrics — the reference's vocabulary, implemented once.
+
+The reference defines ``accuracy_fn`` (eq-count percentage) six separate times
+(``pytorch_cnn.py:111-114`` et al.) and accumulates ``total_test_loss`` by
+hand in every script. This module is the single implementation: jit-friendly
+metric functions plus tiny host-side accumulators.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+
+def accuracy(y_true: jnp.ndarray, y_pred: jnp.ndarray) -> jnp.ndarray:
+    """Percentage of exact label matches — the reference ``accuracy_fn``
+    (``pytorch_cnn.py:111-114``): ``eq(y_true, y_pred).sum() / len * 100``."""
+    correct = jnp.sum(y_true == y_pred)
+    return correct / y_true.size * 100.0
+
+
+def logits_accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """softmax→argmax→accuracy, the reference eval pattern
+    (``pytorch_multilayer_perceptron.py:135-139``). Softmax is monotonic so
+    argmax of logits suffices."""
+    return accuracy(labels, jnp.argmax(logits, axis=-1))
+
+
+@dataclass
+class Sum:
+    """Running sum — ``total_train_loss += loss`` (``pytorch_cnn.py:131``)."""
+
+    total: float = 0.0
+    count: int = 0
+
+    def update(self, value, n: int = 1) -> None:
+        self.total += float(value)
+        self.count += n
+
+    def compute(self) -> float:
+        return self.total
+
+
+@dataclass
+class Mean(Sum):
+    def compute(self) -> float:
+        return self.total / max(self.count, 1)
+
+
+@dataclass
+class MetricBundle:
+    """Named accumulators with one ``log_line`` in the reference's print
+    format (``distributed_cnn.py:188-191``)."""
+
+    metrics: dict = field(default_factory=dict)
+
+    def sum(self, name: str) -> Sum:
+        m = self.metrics.setdefault(name, Sum())
+        assert type(m) is Sum, f"metric {name!r} already registered as {type(m).__name__}"
+        return m
+
+    def mean(self, name: str) -> Mean:
+        m = self.metrics.setdefault(name, Mean())
+        assert isinstance(m, Mean)
+        return m
+
+    def compute(self) -> dict:
+        return {k: v.compute() for k, v in self.metrics.items()}
+
+    def log_line(self) -> str:
+        return " | ".join(f"{k}: {v:.5f}" for k, v in self.compute().items())

@@ -1,0 +1,5 @@
+from machine_learning_apache_spark_tpu.utils.prng import KeySeq, key
+from machine_learning_apache_spark_tpu.utils.logging import get_logger, rank_zero_print
+from machine_learning_apache_spark_tpu.utils.timing import Timer, timed_span
+
+__all__ = ["KeySeq", "key", "get_logger", "rank_zero_print", "Timer", "timed_span"]

@@ -1,0 +1,38 @@
+"""Rank-aware logging.
+
+The reference's observability is bare ``print()`` (SURVEY.md §5). Here the
+same metric vocabulary is emitted through one module, gated to rank 0 by
+default so multi-host runs don't interleave N copies of every line.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+_LOGGERS: dict[str, logging.Logger] = {}
+
+
+def get_logger(name: str = "mlspark") -> logging.Logger:
+    if name not in _LOGGERS:
+        logger = logging.getLogger(name)
+        if not logger.handlers:
+            handler = logging.StreamHandler(sys.stdout)
+            handler.setFormatter(
+                logging.Formatter("[%(asctime)s %(name)s] %(message)s", "%H:%M:%S")
+            )
+            logger.addHandler(handler)
+            logger.setLevel(logging.INFO)
+            logger.propagate = False
+        _LOGGERS[name] = logger
+    return _LOGGERS[name]
+
+
+def rank_zero_print(*args, all_ranks: bool = False, **kwargs) -> None:
+    """``print`` that only fires on process 0 (the reference prints from every
+    rank — e.g. the training prints inside ``train_func`` at
+    ``distributed_cnn.py:188-191`` run once per executor)."""
+    if all_ranks or jax.process_index() == 0:
+        print(*args, **kwargs)

@@ -1,0 +1,36 @@
+"""PRNG key plumbing.
+
+The reference relies on torch's global RNG (implicit seeding); JAX is
+functional, so every source of randomness threads an explicit key. ``KeySeq``
+is the framework's single convention for that.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+class KeySeq:
+    """A splitting key sequence: ``ks = KeySeq(1234); k1 = ks(); k2 = ks()``.
+
+    Deterministic given the seed; also supports named folds so distributed
+    hosts can derive per-rank streams: ``ks.fold(process_index)``.
+    """
+
+    def __init__(self, seed_or_key: int | jax.Array) -> None:
+        self._key = key(seed_or_key) if isinstance(seed_or_key, int) else seed_or_key
+
+    def __call__(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def split(self, n: int) -> jax.Array:
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return jax.numpy.stack(subs)
+
+    def fold(self, data: int) -> "KeySeq":
+        return KeySeq(jax.random.fold_in(self._key, data))

@@ -1,0 +1,32 @@
+"""Test bootstrap: force an 8-virtual-device CPU mesh BEFORE jax import.
+
+This is the JAX analogue of the reference's fake cluster — TorchDistributor
+``local_mode=True`` (``distributed_multilayer_perceptron.py:179``) and the
+manual ``MASTER_ADDR=localhost`` rendezvous block
+(``pytorch_multilayer_perceptron.py:15-21``) — letting every distributed code
+path run on one CPU host (SURVEY.md §4).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The hosting image may pre-import jax from sitecustomize (axon PJRT plugin),
+# in which case env vars are too late — use the config API, which works any
+# time before first backend initialization.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

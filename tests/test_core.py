@@ -1,0 +1,149 @@
+"""Core runtime tests: session, config, mesh, metrics, timing, prng."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import machine_learning_apache_spark_tpu as mlspark
+from machine_learning_apache_spark_tpu.config import SessionConfig, TrainConfig
+from machine_learning_apache_spark_tpu.parallel import (
+    batch_sharding,
+    data_parallel_mesh,
+    make_mesh,
+)
+from machine_learning_apache_spark_tpu.parallel.mesh import shard_batch
+from machine_learning_apache_spark_tpu.train.metrics import (
+    Mean,
+    MetricBundle,
+    Sum,
+    accuracy,
+    logits_accuracy,
+)
+from machine_learning_apache_spark_tpu.utils import KeySeq, Timer, timed_span
+
+
+def test_fake_cluster_has_8_devices():
+    assert jax.device_count() == 8
+    assert jax.default_backend() == "cpu"
+
+
+class TestSession:
+    def test_builder_get_or_create_is_singleton(self):
+        s1 = mlspark.Session.builder.app_name("t").get_or_create()
+        s2 = mlspark.Session.builder.get_or_create()
+        assert s1 is s2
+        s1.stop()
+
+    def test_spark_style_conf_keys(self):
+        s = (
+            mlspark.Session.builder.appName("conf-test")
+            .config("spark.executor.instances", 4)
+            .config("spark.executor.cores", 2)
+            .getOrCreate()
+        )
+        assert s.conf.app_name == "conf-test"
+        assert s.conf.executor_instances == 4
+        assert s.conf.executor_cores == 2
+        # world size derives from runtime, not conf (unlike distributed_cnn.py:43)
+        assert s.executor_count == jax.process_count()
+        assert s.device_count == 8
+        s.stop()
+
+    def test_stop_clears_singleton(self):
+        s = mlspark.Session.builder.get_or_create()
+        s.stop()
+        s2 = mlspark.Session.builder.get_or_create()
+        assert s2 is not s
+        s2.stop()
+
+
+class TestConfig:
+    def test_from_env_override(self, monkeypatch):
+        monkeypatch.setenv("MLSPARK_BATCH_SIZE", "64")
+        monkeypatch.setenv("MLSPARK_LEARNING_RATE", "0.5")
+        cfg = TrainConfig.from_env()
+        assert cfg.batch_size == 64
+        assert cfg.learning_rate == 0.5
+
+    def test_from_args(self):
+        cfg = TrainConfig.from_args(["--epochs", "7", "--optimizer", "sgd"])
+        assert cfg.epochs == 7
+        assert cfg.optimizer == "sgd"
+
+    def test_replace(self):
+        cfg = SessionConfig().replace(app_name="x")
+        assert cfg.app_name == "x"
+
+
+class TestMesh:
+    def test_default_data_parallel(self):
+        mesh = data_parallel_mesh()
+        assert mesh.shape == {"data": 8}
+
+    def test_wildcard(self):
+        mesh = make_mesh({"data": 0, "model": 2})
+        assert mesh.shape["model"] == 2
+        assert mesh.shape["data"] == 4
+
+    def test_2d_mesh_axis_order(self):
+        mesh = make_mesh({"model": 4, "data": 2})
+        # data is the outer axis, model innermost (ICI locality)
+        assert tuple(mesh.axis_names) == ("data", "model")
+
+    def test_invalid_mesh_raises(self):
+        with pytest.raises(ValueError):
+            make_mesh({"data": 3})
+        with pytest.raises(ValueError):
+            make_mesh({"data": 0, "model": 0})
+
+    def test_shard_batch_places_on_mesh(self):
+        mesh = data_parallel_mesh()
+        x = np.arange(32, dtype=np.float32).reshape(16, 2)
+        sharded = shard_batch(mesh, {"x": x})["x"]
+        assert sharded.sharding == batch_sharding(mesh)
+        np.testing.assert_array_equal(np.asarray(sharded), x)
+
+
+class TestMetrics:
+    def test_accuracy_matches_reference_semantics(self):
+        y = jnp.array([0, 1, 2, 2])
+        p = jnp.array([0, 1, 1, 2])
+        assert float(accuracy(y, p)) == 75.0
+
+    def test_logits_accuracy(self):
+        logits = jnp.array([[0.1, 0.9], [0.8, 0.2]])
+        labels = jnp.array([1, 0])
+        assert float(logits_accuracy(logits, labels)) == 100.0
+
+    def test_accumulators(self):
+        b = MetricBundle()
+        for v in [1.0, 2.0, 3.0]:
+            b.sum("total_loss").update(v)
+            b.mean("avg_loss").update(v)
+        out = b.compute()
+        assert out["total_loss"] == 6.0
+        assert out["avg_loss"] == 2.0
+        assert "total_loss" in b.log_line()
+
+
+class TestUtils:
+    def test_keyseq_deterministic(self):
+        a = KeySeq(0)
+        b = KeySeq(0)
+        assert jnp.array_equal(
+            jax.random.key_data(a()), jax.random.key_data(b())
+        )
+        assert not jnp.array_equal(
+            jax.random.key_data(a()), jax.random.key_data(b.fold(1)())
+        )
+
+    def test_timer_and_span(self, capsys):
+        t = Timer("x").start()
+        assert t.lap() >= 0.0
+        with timed_span("Training Time"):
+            pass
+        out = capsys.readouterr().out
+        assert "Training Time" in out and "sec" in out

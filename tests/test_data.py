@@ -1,0 +1,110 @@
+"""Data-layer tests: libsvm round-trip, ArrayFrame, reader API."""
+
+import numpy as np
+import pytest
+
+import machine_learning_apache_spark_tpu as mlspark
+from machine_learning_apache_spark_tpu.data import (
+    ArrayFrame,
+    read_libsvm,
+    write_libsvm,
+)
+
+
+@pytest.fixture
+def libsvm_file(tmp_path, rng):
+    """A file shaped like $SPARK_HOME's sample_multiclass_classification_data:
+    4 features, 3 classes (mllib_multilayer_perceptron_classifier.py:32)."""
+    n = 150
+    features = rng.normal(size=(n, 4)).astype(np.float32).round(4)
+    features[rng.random(size=features.shape) < 0.3] = 0.0  # sparsity
+    labels = rng.integers(0, 3, size=n)
+    path = tmp_path / "sample.txt"
+    write_libsvm(str(path), features, labels)
+    return str(path), features, labels
+
+
+class TestLibsvm:
+    def test_round_trip(self, libsvm_file):
+        path, features, labels = libsvm_file
+        frame = read_libsvm(path, num_features=4)
+        np.testing.assert_allclose(frame.features, features, rtol=1e-5)
+        np.testing.assert_array_equal(frame.labels, labels)
+
+    def test_one_based_indices(self, tmp_path):
+        p = tmp_path / "f.txt"
+        p.write_text("1 1:0.5 3:0.25\n0 2:1.0\n")
+        frame = read_libsvm(str(p))
+        np.testing.assert_allclose(
+            frame.features, [[0.5, 0.0, 0.25], [0.0, 1.0, 0.0]]
+        )
+        np.testing.assert_array_equal(frame.labels, [1, 0])
+
+    def test_malformed_line_raises(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("1 0:0.5\n")  # 0-based index is invalid
+        with pytest.raises(ValueError, match="malformed libsvm line 1"):
+            read_libsvm(str(p))
+
+    def test_num_features_pad_and_overflow(self, tmp_path):
+        p = tmp_path / "f.txt"
+        p.write_text("0 1:1.0\n")
+        assert read_libsvm(str(p), num_features=6).features.shape == (1, 6)
+        with pytest.raises(ValueError):
+            read_libsvm(str(p), num_features=0)
+
+    def test_comments_and_blank_lines(self, tmp_path):
+        p = tmp_path / "f.txt"
+        p.write_text("# header\n\n2 1:3.0  # trailing\n")
+        frame = read_libsvm(str(p))
+        assert len(frame) == 1 and frame.labels[0] == 2
+
+
+class TestArrayFrame:
+    def test_random_split_matches_spark_semantics(self, libsvm_file):
+        """60/40 randomSplit(seed=1234) — mllib_…py:27."""
+        path, *_ = libsvm_file
+        frame = read_libsvm(path)
+        train, test = frame.random_split([0.6, 0.4], seed=1234)
+        assert len(train) + len(test) == len(frame)
+        assert abs(len(train) - 0.6 * len(frame)) <= 1
+        # deterministic given seed
+        train2, _ = frame.randomSplit([0.6, 0.4], seed=1234)
+        np.testing.assert_array_equal(train.features, train2.features)
+        # disjoint
+        seen = {tuple(r) for r in train.features} & {
+            tuple(r) for r in test.features
+        }
+        assert len(seen) == 0 or len(seen) < len(frame) * 0.05
+
+    def test_arrays_dtypes(self):
+        f = ArrayFrame(np.ones((3, 2)), np.array([0.0, 1.0, 2.0]))
+        x, y = f.arrays()
+        assert x.dtype == np.float32 and y.dtype == np.int64
+        assert f.num_features == 2 and f.num_classes == 3
+
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            ArrayFrame(np.ones((3, 2)), np.ones(2))
+
+
+class TestReaderAPI:
+    def test_session_read_libsvm(self, libsvm_file):
+        path, features, _ = libsvm_file
+        session = mlspark.Session.builder.get_or_create()
+        frame = session.read.format("libsvm").option("numFeatures", 4).load(path)
+        assert frame.features.shape == features.shape
+        session.stop()
+
+    def test_csv(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("1.0,2.0,0\n3.0,4.0,1\n")
+        frame = mlspark.Session.builder.get_or_create().read.format("csv").load(str(p))
+        assert frame.num_features == 2
+        np.testing.assert_array_equal(frame.labels, [0, 1])
+
+    def test_unknown_format(self):
+        from machine_learning_apache_spark_tpu.data.reader import DataReader
+
+        with pytest.raises(ValueError, match="unsupported format"):
+            DataReader().format("parquet").load("x")
