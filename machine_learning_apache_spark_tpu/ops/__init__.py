@@ -1,0 +1,35 @@
+"""ops — attention core, masks, positional encodings.
+
+The reference's hot inner kernel is ``scaled_dot_product`` + the mask builders
+(``transformer.py:12-25``, ``pytorch_machine_translator.py:102-104``) — see
+SURVEY.md §3.3. Implemented here once, with *correct* semantics (quirks Q8/Q9
+fixed: boolean masks select, they are never added; query/key lengths are
+independent).
+
+Mask convention (flax-style): boolean, ``True = attendable``. The reference's
+look-ahead mask uses the opposite polarity (True = masked,
+``pytorch_machine_translator.py:102-104``) and then *adds* it (Q9); converting
+at the boundary keeps the framework internally consistent.
+"""
+
+from machine_learning_apache_spark_tpu.ops.masks import (
+    make_causal_mask,
+    make_padding_mask,
+    make_attention_mask,
+    combine_masks,
+)
+from machine_learning_apache_spark_tpu.ops.positional import sinusoidal_encoding
+from machine_learning_apache_spark_tpu.ops.attention import (
+    scaled_dot_product_attention,
+    multi_head_attention_weights,
+)
+
+__all__ = [
+    "make_causal_mask",
+    "make_padding_mask",
+    "make_attention_mask",
+    "combine_masks",
+    "sinusoidal_encoding",
+    "scaled_dot_product_attention",
+    "multi_head_attention_weights",
+]

@@ -1,0 +1,104 @@
+"""Scaled dot-product attention — the framework's hot kernel.
+
+The reference's innermost compute (``scaled_dot_product``,
+``transformer.py:12-25``) is QKᵀ/√d → mask → softmax → ·V. Correct-semantics
+build (SURVEY.md quirk Q9 fixed): boolean mask (True = attendable) applied as
+``where(mask, scores, -inf)`` *before* softmax, no permutes, and query/key
+lengths are independent (Q8 fixed).
+
+Two implementations behind one signature:
+
+- ``scaled_dot_product_attention`` — pure ``jnp``; XLA fuses the softmax chain
+  and tiles the matmuls onto the MXU. Works on every backend.
+- ``machine_learning_apache_spark_tpu.ops.pallas_attention.flash_attention`` —
+  blockwise online-softmax Pallas kernel for TPU (never materializes the
+  [S, S] score matrix). ``dot_product_attention(..., use_pallas=True)``
+  dispatches to it on TPU.
+
+The blockwise structure is the design seam for ring/sequence-parallel
+attention (SURVEY.md §5 long-context): the same per-block accumulator runs
+under ``shard_map`` with K/V blocks rotating over ICI
+(``parallel/ring_attention.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # finite -inf stand-in: keeps fully-masked rows NaN-free
+
+
+def multi_head_attention_weights(
+    query: jnp.ndarray,
+    key: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """``softmax(QKᵀ/√d)`` with boolean masking — the first half of
+    ``scaled_dot_product`` (``transformer.py:17-24``), returned separately
+    because the reference also returns the attention map."""
+    d_k = query.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", query, key)
+    scores = scores / jnp.sqrt(jnp.asarray(d_k, dtype=scores.dtype))
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    # Softmax in float32 regardless of compute dtype: bfloat16 exp/renorm
+    # loses enough precision to hurt training at long sequence lengths.
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return weights.astype(query.dtype)
+
+
+def scaled_dot_product_attention(
+    query: jnp.ndarray,
+    key: jnp.ndarray,
+    value: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    *,
+    return_weights: bool = False,
+):
+    """Attention over ``[..., S, d]`` streams (typically ``[B, H, S, d]``).
+
+    ``mask`` is boolean, True = attendable, broadcastable to
+    ``[..., Sq, Sk]``. Query and key sequence lengths may differ (the
+    cross-attention case the reference mis-handles, Q8).
+    """
+    weights = multi_head_attention_weights(query, key, mask)
+    values = jnp.einsum("...qk,...kd->...qd", weights, value)
+    if return_weights:
+        return values, weights
+    return values
+
+
+def dot_product_attention(
+    query: jnp.ndarray,
+    key: jnp.ndarray,
+    value: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    *,
+    causal: bool = False,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """Backend-dispatching attention entry point used by the model zoo.
+
+    ``use_pallas=None`` auto-selects the Pallas flash kernel on TPU when the
+    mask is either absent or purely causal (the kernel handles causality
+    internally); anything else falls back to the fused-XLA path.
+    """
+    if use_pallas is None:
+        use_pallas = (
+            jax.default_backend() == "tpu" and mask is None
+        )
+    if use_pallas and mask is None:
+        from machine_learning_apache_spark_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+
+        return flash_attention(query, key, value, causal=causal)
+    if causal:
+        from machine_learning_apache_spark_tpu.ops.masks import (
+            combine_masks,
+            make_causal_mask,
+        )
+
+        mask = combine_masks(mask, make_causal_mask(query.shape[-2]))
+    return scaled_dot_product_attention(query, key, value, mask)

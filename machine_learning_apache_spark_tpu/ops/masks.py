@@ -1,0 +1,58 @@
+"""Attention mask builders.
+
+Reference mask sites: look-ahead (causal) mask ``torch.tril(ones)==0`` unsqueezed
+to ``[1,1,S,S]`` (``pytorch_machine_translator.py:102-104``) and padding masks
+``(tensor != pad).unsqueeze(1).unsqueeze(2)`` (``pytorch_machine_translator.py:164-165``).
+
+Convention here: boolean, ``True = position may be attended``. This is the
+*inverse* of the reference's causal-mask polarity; the reference then applies
+its mask additively (quirk Q9, SURVEY.md §2.5) which makes masking a near
+no-op. The framework applies masks with ``where(mask, scores, -inf)`` — the
+evident intent.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def make_causal_mask(length: int, *, dtype=jnp.bool_) -> jnp.ndarray:
+    """``[1, 1, S, S]`` lower-triangular mask: query i may attend keys <= i.
+
+    The correct-semantics build of ``create_look_ahead_mask``
+    (``pytorch_machine_translator.py:102-104``), polarity inverted to the
+    True=attendable convention.
+    """
+    mask = jnp.tril(jnp.ones((length, length), dtype=dtype))
+    return mask[None, None, :, :]
+
+
+def make_padding_mask(tokens: jnp.ndarray, pad_id: int = 0) -> jnp.ndarray:
+    """``[B, 1, 1, S]`` key-padding mask from token ids — the
+    ``(tensor != pad).unsqueeze(1).unsqueeze(2)`` pattern
+    (``pytorch_machine_translator.py:164-165``). Broadcasts over heads and
+    query positions."""
+    return (tokens != pad_id)[:, None, None, :]
+
+
+def make_attention_mask(
+    query_valid: jnp.ndarray, key_valid: jnp.ndarray
+) -> jnp.ndarray:
+    """``[B, 1, Sq, Sk]`` mask from per-position validity vectors.
+
+    Supports *different* query/key lengths — the capability the reference's
+    cross-attention forfeits by reusing the encoder's length for both streams
+    (quirk Q8, ``transformer.py:180-188``).
+    """
+    return (query_valid[:, None, :, None] & key_valid[:, None, None, :])
+
+
+def combine_masks(*masks: jnp.ndarray | None) -> jnp.ndarray | None:
+    """AND together broadcastable masks, skipping Nones (e.g. causal ∧ padding)."""
+    present = [m for m in masks if m is not None]
+    if not present:
+        return None
+    out = present[0]
+    for m in present[1:]:
+        out = out & m
+    return out

@@ -1,0 +1,184 @@
+"""Blockwise flash attention as a Pallas TPU kernel.
+
+The reference's attention core materializes the full ``[S, S]`` score matrix
+(``transformer.py:12-25``). On TPU that is HBM-bandwidth-bound and caps the
+sequence length; this kernel streams K/V blocks through VMEM with an online
+softmax (running max / denominator / output accumulator in scratch), never
+materializing scores — the flash-attention recurrence:
+
+    m_new = max(m, rowmax(S_blk))
+    l_new = l * exp(m - m_new) + rowsum(exp(S_blk - m_new))
+    acc   = acc * exp(m - m_new) + exp(S_blk - m_new) @ V_blk
+
+Grid = (batch*heads, q_blocks, k_blocks) with the k axis innermost and
+sequential, so the scratch accumulators persist across k iterations of one
+q block. The same per-block accumulator is what ``parallel/ring_attention.py``
+rotates over ICI for sequence parallelism (SURVEY.md §5 long-context seam).
+
+Numerics are float32 in the accumulators regardless of input dtype
+(bfloat16-friendly: matmuls feed the MXU in the input dtype, reductions stay
+exact enough to train).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    causal: bool,
+    kv_len: int,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+    scale: float,
+):
+    i = pl.program_id(1)  # query-block index
+    j = pl.program_id(2)  # key-block index (innermost, sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Under causality, key blocks strictly above the diagonal contribute
+    # nothing — skip their compute entirely (this is where flash attention
+    # halves the FLOPs).
+    needed = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _block():
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        v = v_ref[0]  # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale
+        k_idx = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_idx < kv_len  # wrapper zero-pads K; padded keys masked here
+        if causal:
+            q_idx = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = mask & (k_idx <= q_idx)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_cur
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        # Fully-masked rows (query padding) have l == 0; emit zeros, not NaN.
+        l = l_scr[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
+    size = x.shape[axis]
+    target = -(-size // multiple) * multiple
+    if target == size:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - size)
+    return jnp.pad(x, pad)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    query: jnp.ndarray,
+    key: jnp.ndarray,
+    value: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash attention over ``[B, H, S, d]`` streams.
+
+    Query/key lengths may differ (fixing reference quirk Q8). Head dim is
+    zero-padded to the 128-lane boundary; sequence dims to the block size —
+    padding is masked inside the kernel and sliced off the output.
+    """
+    b, h, q_len, d = query.shape
+    kv_len = key.shape[2]
+    scale = 1.0 / math.sqrt(d)
+
+    block_q = min(block_q, max(8, -(-q_len // 8) * 8))
+    block_k = min(block_k, max(128, -(-kv_len // 128) * 128))
+
+    q = _pad_to(_pad_to(query, 2, block_q), 3, 128)
+    k = _pad_to(_pad_to(key, 2, block_k), 3, 128)
+    v = _pad_to(_pad_to(value, 2, block_k), 3, 128)
+    d_pad = q.shape[3]
+    q_pad, k_pad = q.shape[2], k.shape[2]
+
+    bh = b * h
+    q = q.reshape(bh, q_pad, d_pad)
+    k = k.reshape(bh, k_pad, d_pad)
+    v = v.reshape(bh, k_pad, d_pad)
+    num_q_blocks = q_pad // block_q
+    num_k_blocks = k_pad // block_k
+
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        kv_len=kv_len,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=num_k_blocks,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, num_q_blocks, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_pad, d_pad), query.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+    return out.reshape(b, h, q_pad, d_pad)[:, :, :q_len, :d]

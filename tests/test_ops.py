@@ -1,0 +1,145 @@
+"""ops layer tests: mask truth tables, positional encoding, attention numerics.
+
+Models the reference's implicit checks (SURVEY.md §4): causal-mask truth table
+vs ``pytorch_machine_translator.py:102-104`` (polarity corrected), attention
+vs a naive softmax reference, flash kernel vs the fused-XLA path.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from machine_learning_apache_spark_tpu.ops import (
+    combine_masks,
+    make_attention_mask,
+    make_causal_mask,
+    make_padding_mask,
+    scaled_dot_product_attention,
+    sinusoidal_encoding,
+)
+from machine_learning_apache_spark_tpu.ops.pallas_attention import flash_attention
+
+
+class TestMasks:
+    def test_causal_truth_table(self):
+        m = make_causal_mask(4)[0, 0]
+        # Row i may attend columns <= i — tril, the corrected polarity of the
+        # reference's (tril == 0) masked-set.
+        expected = np.tril(np.ones((4, 4), dtype=bool))
+        np.testing.assert_array_equal(np.asarray(m), expected)
+
+    def test_causal_shape(self):
+        assert make_causal_mask(7).shape == (1, 1, 7, 7)
+
+    def test_padding_mask(self):
+        toks = jnp.array([[5, 3, 0, 0], [1, 0, 0, 0]])
+        m = make_padding_mask(toks, pad_id=0)
+        assert m.shape == (2, 1, 1, 4)
+        np.testing.assert_array_equal(
+            np.asarray(m[:, 0, 0]), [[True, True, False, False], [True, False, False, False]]
+        )
+
+    def test_attention_mask_rectangular(self):
+        # Different query/key lengths — the Q8 capability.
+        qv = jnp.array([[True, True, False]])
+        kv = jnp.array([[True, False, True, True, False]])
+        m = make_attention_mask(qv, kv)
+        assert m.shape == (1, 1, 3, 5)
+        assert bool(m[0, 0, 0, 0]) and not bool(m[0, 0, 0, 1])
+        assert not bool(m[0, 0, 2, 0])  # padded query row attends nothing
+
+    def test_combine(self):
+        causal = make_causal_mask(4)
+        pad = make_padding_mask(jnp.array([[1, 1, 0, 0]]))
+        both = combine_masks(causal, pad)
+        assert both.shape == (1, 1, 4, 4)
+        assert not bool(both[0, 0, 3, 2])  # padding wins
+        assert not bool(both[0, 0, 0, 1])  # causality wins
+        assert combine_masks(None, None) is None
+        assert combine_masks(causal, None) is causal
+
+
+class TestPositional:
+    def test_formula(self):
+        pe = np.asarray(sinusoidal_encoding(50, 16))
+        pos, i = 7, 3
+        np.testing.assert_allclose(
+            pe[pos, 2 * i], np.sin(pos / 10000 ** (2 * i / 16)), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            pe[pos, 2 * i + 1], np.cos(pos / 10000 ** (2 * i / 16)), rtol=1e-5
+        )
+
+    def test_first_row(self):
+        pe = np.asarray(sinusoidal_encoding(10, 8))
+        np.testing.assert_allclose(pe[0, 0::2], 0.0, atol=1e-7)
+        np.testing.assert_allclose(pe[0, 1::2], 1.0, atol=1e-7)
+
+
+def _naive_attention(q, k, v, mask=None):
+    d = q.shape[-1]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    if mask is not None:
+        s = np.where(mask, s, -1e30)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+class TestAttention:
+    def test_matches_naive(self, rng):
+        q = rng.standard_normal((2, 3, 5, 8)).astype(np.float32)
+        k = rng.standard_normal((2, 3, 7, 8)).astype(np.float32)
+        v = rng.standard_normal((2, 3, 7, 8)).astype(np.float32)
+        out = scaled_dot_product_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(out), _naive_attention(q, k, v), atol=1e-5)
+
+    def test_masked_positions_ignored(self, rng):
+        q = rng.standard_normal((1, 1, 2, 4)).astype(np.float32)
+        k = rng.standard_normal((1, 1, 3, 4)).astype(np.float32)
+        v = rng.standard_normal((1, 1, 3, 4)).astype(np.float32)
+        mask = jnp.array([[[[True, True, False], [True, True, False]]]])
+        out = scaled_dot_product_attention(*map(jnp.asarray, (q, k, v)), mask)
+        # Changing the masked key/value must not change the output.
+        k2, v2 = k.copy(), v.copy()
+        k2[0, 0, 2] += 100.0
+        v2[0, 0, 2] -= 50.0
+        out2 = scaled_dot_product_attention(*map(jnp.asarray, (q, k2, v2)), mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
+
+    def test_weights_sum_to_one(self, rng):
+        from machine_learning_apache_spark_tpu.ops import multi_head_attention_weights
+
+        q = jnp.asarray(rng.standard_normal((2, 2, 4, 8)), dtype=jnp.float32)
+        k = jnp.asarray(rng.standard_normal((2, 2, 6, 8)), dtype=jnp.float32)
+        w = multi_head_attention_weights(q, k)
+        np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, atol=1e-5)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_xla_path(self, rng, causal):
+        q = jnp.asarray(rng.standard_normal((2, 2, 67, 16)), dtype=jnp.float32)
+        k = jnp.asarray(rng.standard_normal((2, 2, 67, 16)), dtype=jnp.float32)
+        v = jnp.asarray(rng.standard_normal((2, 2, 67, 16)), dtype=jnp.float32)
+        mask = make_causal_mask(67) if causal else None
+        expected = scaled_dot_product_attention(q, k, v, mask)
+        got = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-3)
+
+    def test_cross_lengths(self, rng):
+        q = jnp.asarray(rng.standard_normal((1, 2, 20, 8)), dtype=jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 2, 150, 8)), dtype=jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, 150, 8)), dtype=jnp.float32)
+        expected = scaled_dot_product_attention(q, k, v)
+        got = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-3)
+
+    def test_multi_block(self, rng):
+        # Sequence long enough to exercise >1 q and k block.
+        q = jnp.asarray(rng.standard_normal((1, 1, 300, 8)), dtype=jnp.float32)
+        k, v = q + 0.1, q - 0.1
+        expected = scaled_dot_product_attention(q, k, v, make_causal_mask(300))
+        got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-3)
