@@ -1,0 +1,27 @@
+"""Model zoo — MLP, CNN, LSTM, encoder-decoder Transformer.
+
+One library replacing the reference's copy-pasted per-script model classes
+(C2/C5/C8 duplicated across sequential/distributed scripts, SURVEY.md §2.1)
+and its ``transformer.py`` module library (C14-C23).
+"""
+
+from machine_learning_apache_spark_tpu.models.mlp import MLP
+from machine_learning_apache_spark_tpu.models.cnn import TinyVGG, FashionMNISTModel
+from machine_learning_apache_spark_tpu.models.lstm import LSTMClassifier
+from machine_learning_apache_spark_tpu.models.transformer import (
+    Transformer,
+    Encoder,
+    Decoder,
+    TransformerConfig,
+)
+
+__all__ = [
+    "MLP",
+    "TinyVGG",
+    "FashionMNISTModel",
+    "LSTMClassifier",
+    "Transformer",
+    "Encoder",
+    "Decoder",
+    "TransformerConfig",
+]

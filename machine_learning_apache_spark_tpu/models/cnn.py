@@ -1,0 +1,46 @@
+"""TinyVGG-style CNN for FashionMNIST-class workloads.
+
+Reference: ``FashionMNISTModel`` (``pytorch_cnn.py:12-49``, duplicated
+``distributed_cnn.py:47-86``): two conv blocks of
+[Conv3x3 s1 p1 → ReLU → Conv3x3 → ReLU → MaxPool2] then Flatten →
+Linear(hidden·7·7 → classes), with ``input_shape=1, hidden_units=10``
+(``pytorch_cnn.py:94-96``).
+
+TPU-first deltas: NHWC layout (XLA:TPU's native conv layout — NCHW would
+insert transposes around every conv), and the classifier head infers its
+input width from the actual spatial shape instead of hardcoding 7·7.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TinyVGG(nn.Module):
+    """Two-block VGG mini. Input ``[B, H, W, C]`` (NHWC), e.g. 28×28×1."""
+
+    hidden_units: int = 10
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        # Accepted for zoo-wide signature uniformity; TinyVGG has no dropout.
+        del deterministic
+        for block in range(2):
+            for conv in range(2):
+                x = nn.Conv(
+                    self.hidden_units,
+                    kernel_size=(3, 3),
+                    strides=1,
+                    padding=1,
+                    name=f"block{block}_conv{conv}",
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, name="classifier")(x)
+
+
+# The reference's class name, for API-parity imports.
+FashionMNISTModel = TinyVGG

@@ -1,0 +1,108 @@
+"""LSTM text classifier.
+
+Reference: ``LSTM`` (``pytorch_lstm.py:94-119``, drifted duplicate
+``distributed_lstm.py:110-135``): Embedding → 2-layer ``nn.LSTM``
+(batch_first, dropout=0.5 between layers) → Linear head, with explicit
+``(hidden, mem)`` state threading through ``forward`` and zero-init state per
+batch (``pytorch_lstm.py:153-154``). Quirk Q10 (head width hardcoded to 32,
+``padding_idx`` passed the string ``'0'``) is fixed: the head uses
+``hidden_size`` and padding embeds are simply trained.
+
+TPU-first design (SURVEY.md §7 "hard parts"): torch's fused multi-layer LSTM
+kernel becomes ``jax.lax.scan`` over time with the *input-side* gate
+projection hoisted out of the scan — ``x @ W_x`` for all timesteps is one
+large ``[B·S, E]×[E, 4H]`` matmul the MXU tiles efficiently, leaving only the
+``[B, H]×[H, 4H]`` recurrent matmul inside the sequential loop. Inter-layer
+dropout matches torch's ``dropout=0.5`` placement (not on the last layer's
+output).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LSTMLayer(nn.Module):
+    """One recurrent layer: ``lax.scan`` of the LSTM cell over time.
+
+    Gate order follows the standard (i, f, g, o) convention. Carries are
+    ``(h, c)`` with shape ``[B, hidden]`` each.
+    """
+
+    hidden_size: int
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, state: tuple[jnp.ndarray, jnp.ndarray] | None = None
+    ):
+        batch, _seq, in_dim = x.shape
+        h0, c0 = state if state is not None else (
+            jnp.zeros((batch, self.hidden_size), x.dtype),
+            jnp.zeros((batch, self.hidden_size), x.dtype),
+        )
+        w_x = self.param(
+            "w_x", nn.initializers.lecun_normal(), (in_dim, 4 * self.hidden_size)
+        )
+        w_h = self.param(
+            "w_h", nn.initializers.orthogonal(), (self.hidden_size, 4 * self.hidden_size)
+        )
+        bias = self.param("bias", nn.initializers.zeros_init(), (4 * self.hidden_size,))
+
+        # Input projection for the whole sequence at once: one big MXU matmul
+        # instead of S small ones inside the scan.
+        gates_x = jnp.einsum("bse,eh->bsh", x, w_x) + bias
+
+        def cell(carry, gx):
+            h, c = carry
+            gates = gx + h @ w_h
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+            h = nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        (h_n, c_n), ys = jax.lax.scan(
+            cell, (h0, c0), jnp.swapaxes(gates_x, 0, 1)
+        )
+        return jnp.swapaxes(ys, 0, 1), (h_n, c_n)
+
+
+class LSTMClassifier(nn.Module):
+    """Embedding → stacked LSTM → Dense head (reference C8).
+
+    ``__call__`` accepts and returns the explicit per-layer ``(h, c)`` states
+    the reference threads manually; passing ``None`` zero-initializes them
+    (``pytorch_lstm.py:153-154``). Returns per-timestep logits ``[B, S, C]``;
+    the classification recipe takes the last timestep
+    (``pytorch_lstm.py:160`` uses ``pred[:, -1, :]``).
+    """
+
+    vocab_size: int
+    embed_dim: int = 32
+    hidden_size: int = 32
+    num_classes: int = 4
+    num_layers: int = 2
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jnp.ndarray,
+        state: list[tuple[jnp.ndarray, jnp.ndarray]] | None = None,
+        *,
+        deterministic: bool = True,
+        return_state: bool = False,
+    ):
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="embedding")(tokens)
+        new_state = []
+        for layer in range(self.num_layers):
+            layer_state = state[layer] if state is not None else None
+            x, s = LSTMLayer(self.hidden_size, name=f"lstm_{layer}")(x, layer_state)
+            new_state.append(s)
+            if layer < self.num_layers - 1:
+                x = nn.Dropout(self.dropout, deterministic=deterministic)(x)
+        logits = nn.Dense(self.num_classes, name="head")(x)
+        if return_state:
+            return logits, new_state
+        return logits

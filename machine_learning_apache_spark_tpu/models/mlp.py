@@ -1,0 +1,43 @@
+"""Multilayer perceptron.
+
+The reference defines this twice (``Multilayer_perceptor``,
+``pytorch_multilayer_perceptron.py:33-42`` and
+``distributed_multilayer_perceptron.py:44-53``): Linear stack with Sigmoid
+between layers and no final activation. Layer spec follows MLlib's
+full-topology convention ``layers=[in, hidden..., out]``
+(``mllib_multilayer_perceptron_classifier.py:32`` uses ``[4, 5, 4, 3]``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """``MLP(layers=(4, 5, 4, 3))`` — the reference MLP family (C2).
+
+    ``layers[0]`` is the expected input width (validated), the rest are layer
+    output widths. ``activation`` sits between layers only; logits come out
+    raw for a downstream softmax cross-entropy.
+    """
+
+    layers: Sequence[int] = (4, 5, 4, 3)
+    activation: Callable[[jnp.ndarray], jnp.ndarray] = nn.sigmoid
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        # `deterministic` is accepted (and ignored — no dropout here) so the
+        # zoo shares one train/eval loss signature.
+        del deterministic
+        if x.shape[-1] != self.layers[0]:
+            raise ValueError(
+                f"MLP expects {self.layers[0]} input features, got {x.shape[-1]}"
+            )
+        for i, width in enumerate(self.layers[1:]):
+            x = nn.Dense(width, name=f"dense_{i}")(x)
+            if i < len(self.layers) - 2:
+                x = self.activation(x)
+        return x

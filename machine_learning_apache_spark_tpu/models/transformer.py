@@ -1,0 +1,305 @@
+"""Encoder-decoder Transformer.
+
+Reference: the 11-class ``transformer.py`` module library (C14-C23,
+SURVEY.md §2.1) used by the en→de MT driver
+(``pytorch_machine_translator.py:120``: d_model=512, ffn=1024, heads=8,
+drop=0.1, layers=1, max_seq=200).
+
+Correct-semantics deltas from the reference (SURVEY.md §2.5):
+- Q9: masks are boolean (True = attendable) applied ``where(mask, s, -inf)``
+  before softmax — never added.
+- Q8: cross-attention reshapes Q with the *decoder's* length and K/V with the
+  *encoder's*; src/trg sequence lengths are independent.
+- C15: positional encodings are a trace-time constant, not recomputed and
+  re-transferred per forward.
+- C18's hand-rolled LayerNorm is ``nn.LayerNorm`` (same math, fused by XLA).
+
+Structure is post-LN residual (``x = LN(x + drop(sublayer(x)))``) matching
+``transformer.py:130-139``. Attention runs through the shared ops core, which
+dispatches to the Pallas flash kernel when maskless/causal on TPU.
+
+Tensor-parallel seam: every Dense hidden axis is annotated with the logical
+axis names ``("embed", "mlp"/"heads")`` via ``nn.with_partitioning`` — the
+``parallel`` package maps these onto the mesh's ``"model"`` axis for TP runs
+and to unsharded for single-chip runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from machine_learning_apache_spark_tpu.ops.attention import (
+    scaled_dot_product_attention,
+)
+from machine_learning_apache_spark_tpu.ops.masks import (
+    combine_masks,
+    make_causal_mask,
+    make_padding_mask,
+)
+from machine_learning_apache_spark_tpu.ops.positional import sinusoidal_encoding
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Hyperparameters — the reference ctor signature (``transformer.py:256-267``)
+    plus compute dtype. Defaults are the MT driver's
+    (``pytorch_machine_translator.py:108-117``)."""
+
+    src_vocab_size: int
+    trg_vocab_size: int
+    d_model: int = 512
+    ffn_hidden: int = 1024
+    num_heads: int = 8
+    num_layers: int = 1
+    dropout: float = 0.1
+    max_len: int = 200
+    pad_id: int = 0
+    dtype: jnp.dtype = jnp.float32  # bfloat16 for MXU-native training
+
+
+def _dense(features: int, cfg: TransformerConfig, name: str, logical_out: str):
+    """Dense with TP logical partitioning on (in, out) kernel axes."""
+    return nn.Dense(
+        features,
+        dtype=cfg.dtype,
+        name=name,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.lecun_normal(), ("embed", logical_out)
+        ),
+    )
+
+
+class SentenceEmbedding(nn.Module):
+    """Token embedding + positional encoding + dropout (C16,
+    ``transformer.py:44-62``), with the PE table cached (C15 fix)."""
+
+    vocab_size: int
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, *, deterministic: bool = True):
+        x = nn.Embed(
+            self.vocab_size,
+            self.cfg.d_model,
+            dtype=self.cfg.dtype,
+            embedding_init=nn.with_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, "embed")
+            ),
+            name="embed",
+        )(tokens)
+        pe = sinusoidal_encoding(tokens.shape[-1], self.cfg.d_model, self.cfg.dtype)
+        x = x + pe
+        return nn.Dropout(self.cfg.dropout, deterministic=deterministic)(x)
+
+
+class MultiHeadAttention(nn.Module):
+    """Self- or cross-attention with fused projections.
+
+    Self-attention uses a fused QKV ``Linear(d, 3d)`` like the reference C17
+    (``transformer.py:74-83``); cross-attention fuses KV (C21) but — fixing
+    Q8 — reshapes each stream with its own length.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x_q: jnp.ndarray,
+        x_kv: jnp.ndarray | None = None,
+        mask: jnp.ndarray | None = None,
+        *,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        cfg = self.cfg
+        head_dim = cfg.d_model // cfg.num_heads
+        b, s_q, _ = x_q.shape
+
+        def split_heads(t, length):
+            return t.reshape(b, length, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        if x_kv is None:
+            qkv = _dense(3 * cfg.d_model, cfg, "qkv", "heads")(x_q)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            s_kv = s_q
+        else:
+            s_kv = x_kv.shape[1]
+            kv = _dense(2 * cfg.d_model, cfg, "kv", "heads")(x_kv)
+            k, v = jnp.split(kv, 2, axis=-1)
+            q = _dense(cfg.d_model, cfg, "q", "heads")(x_q)
+
+        out = scaled_dot_product_attention(
+            split_heads(q, s_q), split_heads(k, s_kv), split_heads(v, s_kv), mask
+        )
+        out = out.transpose(0, 2, 1, 3).reshape(b, s_q, cfg.d_model)
+        return nn.Dense(
+            cfg.d_model,
+            dtype=cfg.dtype,
+            name="out",
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "embed")
+            ),
+        )(out)
+
+
+class FeedForward(nn.Module):
+    """Position-wise FFN (C19, ``transformer.py:104-117``):
+    Dense(ffn) → ReLU → Dropout → Dense(d)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True):
+        cfg = self.cfg
+        h = _dense(cfg.ffn_hidden, cfg, "up", "mlp")(x)
+        h = nn.relu(h)
+        h = nn.Dropout(cfg.dropout, deterministic=deterministic)(h)
+        return nn.Dense(
+            cfg.d_model,
+            dtype=cfg.dtype,
+            name="down",
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("mlp", "embed")
+            ),
+        )(h)
+
+
+class EncoderLayer(nn.Module):
+    """Post-LN residual block (C20, ``transformer.py:120-139``)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, deterministic: bool = True):
+        drop = nn.Dropout(self.cfg.dropout, deterministic=deterministic)
+        attn = MultiHeadAttention(self.cfg, name="self_attn")(
+            x, mask=mask, deterministic=deterministic
+        )
+        x = nn.LayerNorm(dtype=self.cfg.dtype, name="ln1")(x + drop(attn))
+        ffn = FeedForward(self.cfg, name="ffn")(x, deterministic=deterministic)
+        return nn.LayerNorm(dtype=self.cfg.dtype, name="ln2")(x + drop(ffn))
+
+
+class Encoder(nn.Module):
+    """Embedding + layer stack (C20's ``Encoder``, ``transformer.py:149-166``)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, src_tokens, src_mask=None, *, deterministic: bool = True):
+        x = SentenceEmbedding(self.cfg.src_vocab_size, self.cfg, name="embed")(
+            src_tokens, deterministic=deterministic
+        )
+        for i in range(self.cfg.num_layers):
+            x = EncoderLayer(self.cfg, name=f"layer_{i}")(
+                x, src_mask, deterministic=deterministic
+            )
+        return x
+
+
+class DecoderLayer(nn.Module):
+    """Self-attn + cross-attn + FFN, each post-LN residual (C22,
+    ``transformer.py:194-224``)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self, y, memory, self_mask=None, cross_mask=None, *, deterministic: bool = True
+    ):
+        drop = nn.Dropout(self.cfg.dropout, deterministic=deterministic)
+        attn = MultiHeadAttention(self.cfg, name="self_attn")(
+            y, mask=self_mask, deterministic=deterministic
+        )
+        y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln1")(y + drop(attn))
+        cross = MultiHeadAttention(self.cfg, name="cross_attn")(
+            y, memory, mask=cross_mask, deterministic=deterministic
+        )
+        y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln2")(y + drop(cross))
+        ffn = FeedForward(self.cfg, name="ffn")(y, deterministic=deterministic)
+        return nn.LayerNorm(dtype=self.cfg.dtype, name="ln3")(y + drop(ffn))
+
+
+class Decoder(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        trg_tokens,
+        memory,
+        self_mask=None,
+        cross_mask=None,
+        *,
+        deterministic: bool = True,
+    ):
+        y = SentenceEmbedding(self.cfg.trg_vocab_size, self.cfg, name="embed")(
+            trg_tokens, deterministic=deterministic
+        )
+        for i in range(self.cfg.num_layers):
+            y = DecoderLayer(self.cfg, name=f"layer_{i}")(
+                y, memory, self_mask, cross_mask, deterministic=deterministic
+            )
+        return y
+
+
+class Transformer(nn.Module):
+    """Encoder + Decoder + LM head (C23, ``transformer.py:255-284``).
+
+    ``__call__(src_tokens, trg_tokens)`` builds the three masks from the pad
+    id — src self-attn padding, trg causal∧padding, cross (trg queries over
+    src keys) — matching the MT driver's mask plumbing
+    (``pytorch_machine_translator.py:164-177``) but with the correct
+    semantics; explicit masks may be passed to override.
+    """
+
+    cfg: TransformerConfig
+
+    def setup(self):
+        self.encoder = Encoder(self.cfg)
+        self.decoder = Decoder(self.cfg)
+        # LM head: d_model → trg vocab, the reference's Linear(512, |de|)
+        # (``transformer.py:271,283``), vocab axis model-sharded under TP.
+        self.lm_head = nn.Dense(
+            self.cfg.trg_vocab_size,
+            dtype=self.cfg.dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+        )
+
+    def __call__(
+        self,
+        src_tokens: jnp.ndarray,
+        trg_tokens: jnp.ndarray,
+        src_mask: jnp.ndarray | None = None,
+        trg_mask: jnp.ndarray | None = None,
+        cross_mask: jnp.ndarray | None = None,
+        *,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        pad = self.cfg.pad_id
+        if src_mask is None:
+            src_mask = make_padding_mask(src_tokens, pad)
+        if trg_mask is None:
+            trg_mask = combine_masks(
+                make_causal_mask(trg_tokens.shape[-1]),
+                make_padding_mask(trg_tokens, pad),
+            )
+        if cross_mask is None:
+            # Decoder queries over encoder keys: mask padded *source* keys.
+            cross_mask = make_padding_mask(src_tokens, pad)
+        memory = self.encoder(src_tokens, src_mask, deterministic=deterministic)
+        y = self.decoder(
+            trg_tokens, memory, trg_mask, cross_mask, deterministic=deterministic
+        )
+        return self.lm_head(y)
+
+    def encode(self, src_tokens, *, deterministic: bool = True):
+        return self.encoder(
+            src_tokens, make_padding_mask(src_tokens, self.cfg.pad_id),
+            deterministic=deterministic,
+        )

@@ -100,5 +100,7 @@ def dot_product_attention(
             make_causal_mask,
         )
 
-        mask = combine_masks(mask, make_causal_mask(query.shape[-2]))
+        mask = combine_masks(
+            mask, make_causal_mask(query.shape[-2], key.shape[-2])
+        )
     return scaled_dot_product_attention(query, key, value, mask)

@@ -16,14 +16,20 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def make_causal_mask(length: int, *, dtype=jnp.bool_) -> jnp.ndarray:
-    """``[1, 1, S, S]`` lower-triangular mask: query i may attend keys <= i.
+def make_causal_mask(
+    length: int, kv_length: int | None = None, *, dtype=jnp.bool_
+) -> jnp.ndarray:
+    """``[1, 1, Sq, Sk]`` causal mask: query i may attend keys <= i.
 
     The correct-semantics build of ``create_look_ahead_mask``
     (``pytorch_machine_translator.py:102-104``), polarity inverted to the
-    True=attendable convention.
+    True=attendable convention. With ``kv_length != length`` the diagonal is
+    bottom-right aligned (the KV-cache decode convention: the last query row
+    sees every key), matching the flash kernel.
     """
-    mask = jnp.tril(jnp.ones((length, length), dtype=dtype))
+    kv_length = length if kv_length is None else kv_length
+    offset = kv_length - length
+    mask = jnp.tril(jnp.ones((length, kv_length), dtype=dtype), k=offset)
     return mask[None, None, :, :]
 
 

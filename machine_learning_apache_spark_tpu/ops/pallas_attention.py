@@ -43,6 +43,7 @@ def _flash_kernel(
     acc_scr,
     *,
     causal: bool,
+    causal_offset: int,
     kv_len: int,
     block_q: int,
     block_k: int,
@@ -61,7 +62,11 @@ def _flash_kernel(
     # Under causality, key blocks strictly above the diagonal contribute
     # nothing — skip their compute entirely (this is where flash attention
     # halves the FLOPs).
-    needed = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    needed = (
+        (j * block_k <= i * block_q + block_q - 1 + causal_offset)
+        if causal
+        else True
+    )
 
     @pl.when(needed)
     def _block():
@@ -77,10 +82,12 @@ def _flash_kernel(
         )
         mask = k_idx < kv_len  # wrapper zero-pads K; padded keys masked here
         if causal:
+            # Bottom-right-aligned diagonal: the last real query row sees all
+            # kv_len keys even when q_len != kv_len (decode convention).
             q_idx = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            mask = mask & (k_idx <= q_idx)
+            mask = mask & (k_idx <= q_idx + causal_offset)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:]
@@ -154,6 +161,7 @@ def flash_attention(
     kernel = functools.partial(
         _flash_kernel,
         causal=causal,
+        causal_offset=kv_len - q_len,
         kv_len=kv_len,
         block_q=block_q,
         block_k=block_k,

@@ -1,8 +1,40 @@
+"""train — losses, metrics, train state, fit/evaluate loops (reference L7)."""
+
+from machine_learning_apache_spark_tpu.train.losses import (
+    cross_entropy,
+    masked_token_cross_entropy,
+)
 from machine_learning_apache_spark_tpu.train.metrics import (
-    accuracy,
     Mean,
-    Sum,
     MetricBundle,
+    Sum,
+    accuracy,
+    logits_accuracy,
+)
+from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
+from machine_learning_apache_spark_tpu.train.loop import (
+    FitResult,
+    classification_loss,
+    evaluate,
+    fit,
+    make_eval_step,
+    make_train_step,
 )
 
-__all__ = ["accuracy", "Mean", "Sum", "MetricBundle"]
+__all__ = [
+    "cross_entropy",
+    "masked_token_cross_entropy",
+    "Mean",
+    "MetricBundle",
+    "Sum",
+    "accuracy",
+    "logits_accuracy",
+    "TrainState",
+    "make_optimizer",
+    "FitResult",
+    "classification_loss",
+    "evaluate",
+    "fit",
+    "make_eval_step",
+    "make_train_step",
+]

@@ -1,0 +1,195 @@
+"""Training/eval loop machinery — reference layer L7, implemented once.
+
+Every reference script re-implements the same loop inline (SURVEY.md §1 L7):
+epochs × batches of {forward → loss → zero_grad → backward → step}, then an
+eval pass of softmax→argmax→accuracy, with wall-clock prints. Here the loop
+body is a single jitted function (forward+backward+update fused into one XLA
+program) and the Python loop only feeds batches and accumulates metrics.
+
+Data parallelism needs no separate loop: with params replicated and the batch
+sharded over the mesh's ``"data"`` axis, XLA's sharding propagation compiles
+the gradient reduction into a ``psum`` over ICI — the reference's entire
+DDP/gloo layer (C11) disappears into the compiled step (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+
+from machine_learning_apache_spark_tpu.parallel.mesh import replicate, shard_batch
+from machine_learning_apache_spark_tpu.train.metrics import MetricBundle, logits_accuracy
+from machine_learning_apache_spark_tpu.train.state import TrainState
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+from machine_learning_apache_spark_tpu.utils.timing import Timer
+
+log = get_logger(__name__)
+
+# loss_fn contract: (params, batch, rng) -> (scalar_loss, aux_dict)
+LossFn = Callable[[Any, Any, jax.Array], tuple[jnp.ndarray, dict]]
+
+
+def make_train_step(loss_fn: LossFn):
+    """One fused forward+backward+update XLA program."""
+
+    @jax.jit
+    def step(state: TrainState, batch, rng: jax.Array):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, rng
+        )
+        return state.apply_gradients(grads), loss, aux
+
+    return step
+
+
+def make_eval_step(loss_fn: LossFn):
+    @jax.jit
+    def step(state: TrainState, batch, rng: jax.Array):
+        return loss_fn(state.params, batch, rng)
+
+    return step
+
+
+@dataclass
+class FitResult:
+    state: TrainState
+    train_seconds: float
+    history: list[dict] = field(default_factory=list)
+
+    @property
+    def final_loss(self) -> float:
+        return self.history[-1]["loss"] if self.history else float("nan")
+
+
+def fit(
+    state: TrainState,
+    loss_fn: LossFn,
+    train_loader: Iterable,
+    *,
+    epochs: int,
+    rng: jax.Array | None = None,
+    mesh=None,
+    log_every: int = 100,
+    emit: Callable[[str], None] | None = None,
+) -> FitResult:
+    """The canonical loop (``pytorch_cnn.py:125-146`` shape): epochs × batches,
+    per-``log_every``-batch loss/time prints
+    (``pytorch_machine_translator.py:199-205``), total wall-time at the end
+    (the universal reference metric, SURVEY.md §6).
+
+    ``train_loader`` yields batch pytrees; if it has ``set_epoch``, it is
+    called per epoch (the ``sampler.set_epoch`` contract,
+    ``distributed_cnn.py:168``, with correct Q3 semantics).
+    """
+    emit = emit or log.info
+    rng = rng if rng is not None else jax.random.key(0)
+    step_fn = make_train_step(loss_fn)
+    if mesh is not None:
+        state = replicate(mesh, state)
+
+    history: list[dict] = []
+    total_timer = Timer("train").start()
+    span_timer = Timer("span").start()
+    global_step = 0
+    for epoch in range(epochs):
+        if hasattr(train_loader, "set_epoch"):
+            train_loader.set_epoch(epoch)
+        epoch_metrics = MetricBundle()
+        # Step outputs stay on-device until a log point — float()ing per step
+        # would sync the host into every step and serialize async dispatch.
+        pending: list[tuple] = []
+
+        def _drain():
+            for dev_loss, dev_aux in jax.device_get(pending):
+                epoch_metrics.mean("loss").update(dev_loss)
+                for k, v in dev_aux.items():
+                    epoch_metrics.mean(k).update(v)
+            pending.clear()
+
+        for batch in train_loader:
+            if mesh is not None:
+                batch = shard_batch(mesh, batch)
+            rng, step_rng = jax.random.split(rng)
+            state, loss, aux = step_fn(state, batch, step_rng)
+            global_step += 1
+            pending.append((loss, aux))
+            if log_every and global_step % log_every == 0:
+                _drain()
+                emit(
+                    f"epoch {epoch} step {global_step} | "
+                    f"{epoch_metrics.log_line()} | {span_timer.lap():.3f} sec/{log_every} batches"
+                )
+        _drain()
+        computed = epoch_metrics.compute()
+        computed["epoch"] = epoch
+        history.append(computed)
+        if log_every:
+            emit(f"epoch {epoch} done | {epoch_metrics.log_line()}")
+    # Block on the final state so the reported wall-time includes device work
+    # (the reference's time.time() pairs measure eager CPU execution; under
+    # async dispatch the analogue requires a sync point).
+    jax.block_until_ready(state.params)
+    seconds = total_timer.stop()
+    emit(f"Training Time: {seconds:.3f} sec")
+    return FitResult(state=state, train_seconds=seconds, history=history)
+
+
+def evaluate(
+    state: TrainState,
+    loss_fn: LossFn,
+    eval_loader: Iterable,
+    *,
+    mesh=None,
+    rng: jax.Array | None = None,
+    emit: Callable[[str], None] | None = None,
+) -> dict:
+    """Eval pass: accumulated loss + metrics — the reference's
+    ``model.eval()`` + ``no_grad`` + accuracy block
+    (``pytorch_cnn.py:154-176``). Deterministic (loss_fn receives a fixed
+    key; dropout layers must run deterministic under it)."""
+    emit = emit or log.info
+    rng = rng if rng is not None else jax.random.key(0)
+    step_fn = make_eval_step(loss_fn)
+    metrics = MetricBundle()
+    for batch in eval_loader:
+        if mesh is not None:
+            batch = shard_batch(mesh, batch)
+        loss, aux = step_fn(state, batch, rng)
+        n = len(jax.tree.leaves(batch)[0])
+        metrics.mean("test_loss").update(loss, n)
+        for k, v in aux.items():
+            metrics.mean(k).update(v, n)
+    out = metrics.compute()
+    emit(" | ".join(f"{k}: {v:.5f}" for k, v in out.items()))
+    return out
+
+
+def classification_loss(
+    apply_fn, *, last_timestep: bool = False, train: bool = True
+) -> LossFn:
+    """Standard CE classification loss over ``(features, labels)`` batches.
+
+    ``last_timestep=True`` selects ``logits[:, -1, :]`` — the LSTM recipe's
+    last-position head (``pytorch_lstm.py:160``). ``train=True`` runs dropout
+    (``model.train()``); pass ``train=False`` for the eval pass
+    (``model.eval()`` + ``no_grad``, ``pytorch_cnn.py:154-176``).
+    """
+    from machine_learning_apache_spark_tpu.train.losses import cross_entropy
+
+    def loss_fn(params, batch, rng):
+        features, labels = batch
+        logits = apply_fn(
+            {"params": params},
+            features,
+            deterministic=not train,
+            rngs={"dropout": rng} if train else None,
+        )
+        if last_timestep:
+            logits = logits[:, -1, :]
+        loss = cross_entropy(logits, labels)
+        return loss, {"accuracy": logits_accuracy(logits, labels)}
+
+    return loss_fn

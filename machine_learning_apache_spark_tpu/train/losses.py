@@ -1,0 +1,43 @@
+"""Loss functions.
+
+The reference uses ``nn.CrossEntropyLoss`` everywhere; the MT driver uses the
+per-token variant with ``ignore_index=0, reduction='none'`` followed by a
+manual pad-masked mean (``pytorch_machine_translator.py:125-126,182-188``).
+Both shapes live here, once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy over integer labels — ``nn.CrossEntropyLoss``
+    default semantics (``pytorch_cnn.py:108``)."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def masked_token_cross_entropy(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    pad_id: int = 0,
+) -> jnp.ndarray:
+    """Pad-masked per-token CE: per-token losses where ``label != pad_id``,
+    averaged over real tokens only — the MT driver's
+    ``ignore_index=0, reduction='none'`` + manual mask-mean
+    (``pytorch_machine_translator.py:182-188``).
+
+    ``logits``: [..., S, V]; ``labels``: [..., S].
+    """
+    per_token = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    mask = (labels != pad_id).astype(per_token.dtype)
+    total = jnp.sum(per_token * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count
+
+
+def l2_regularization(params, scale: float) -> jnp.ndarray:
+    leaves = jax.tree.leaves(params)
+    return scale * sum(jnp.sum(jnp.square(p)) for p in leaves)

@@ -44,6 +44,13 @@ class Sum:
 
 @dataclass
 class Mean(Sum):
+    """Weighted running mean: ``update(value, n)`` treats ``value`` as a mean
+    over ``n`` samples (n=1 for per-step scalars)."""
+
+    def update(self, value, n: int = 1) -> None:
+        self.total += float(value) * n
+        self.count += n
+
     def compute(self) -> float:
         return self.total / max(self.count, 1)
 

@@ -1,0 +1,56 @@
+"""Train state: params + optimizer, the reference's ``model.parameters()`` ↔
+``optimizer`` pair (``pytorch_multilayer_perceptron.py:93-96``), functional.
+
+``make_optimizer`` covers the reference's optimizer vocabulary: SGD
+(``pytorch_cnn.py:119`` lr=0.01, ``pytorch_multilayer_perceptron.py:96``
+lr=0.03) and Adam (``pytorch_lstm.py:127`` lr=1e-3,
+``pytorch_machine_translator.py:129``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+from flax import struct
+
+
+def make_optimizer(name: str = "adam", learning_rate: float = 1e-3, **kw) -> optax.GradientTransformation:
+    name = name.lower()
+    if name == "sgd":
+        return optax.sgd(learning_rate, **kw)
+    if name == "adam":
+        return optax.adam(learning_rate, **kw)
+    if name == "adamw":
+        return optax.adamw(learning_rate, **kw)
+    raise ValueError(f"unknown optimizer {name!r}")
+
+
+class TrainState(struct.PyTreeNode):
+    """Carry for the jitted train step: params, opt state, step counter.
+
+    A lean re-implementation of ``flax.training.train_state.TrainState`` kept
+    first-party so the sharding rules in ``parallel`` can address it without
+    version skew.
+    """
+
+    step: jax.Array | int
+    params: Any
+    opt_state: optax.OptState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx) -> "TrainState":
+        return cls(
+            step=0, params=params, opt_state=tx.init(params), apply_fn=apply_fn, tx=tx
+        )
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            opt_state=new_opt,
+        )

@@ -136,6 +136,20 @@ class TestFlashAttention:
         got = flash_attention(q, k, v, interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-3)
 
+    def test_rectangular_causal(self, rng):
+        # Decode-style: few queries over a long key history; bottom-right
+        # aligned diagonal must match the mask-based XLA path.
+        from machine_learning_apache_spark_tpu.ops.attention import dot_product_attention
+
+        q = jnp.asarray(rng.standard_normal((1, 2, 4, 8)), dtype=jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 2, 20, 8)), dtype=jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, 20, 8)), dtype=jnp.float32)
+        expected = scaled_dot_product_attention(q, k, v, make_causal_mask(4, 20))
+        got_xla = dot_product_attention(q, k, v, causal=True, use_pallas=False)
+        got_flash = flash_attention(q, k, v, causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(got_xla), np.asarray(expected), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_flash), np.asarray(expected), atol=2e-3)
+
     def test_multi_block(self, rng):
         # Sequence long enough to exercise >1 q and k block.
         q = jnp.asarray(rng.standard_normal((1, 1, 300, 8)), dtype=jnp.float32)

@@ -1,0 +1,134 @@
+"""Training machinery tests: losses, state, and the numerics tier of
+SURVEY.md §4 — loss decreases over N steps per model family (the reference's
+implicit correctness criterion)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from machine_learning_apache_spark_tpu.models import MLP, LSTMClassifier, TinyVGG
+from machine_learning_apache_spark_tpu.train import (
+    TrainState,
+    classification_loss,
+    cross_entropy,
+    evaluate,
+    fit,
+    make_optimizer,
+    masked_token_cross_entropy,
+)
+
+
+class TestLosses:
+    def test_cross_entropy_matches_manual(self, rng):
+        logits = jnp.asarray(rng.standard_normal((8, 5)), dtype=jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 5, 8))
+        expected = -np.mean(
+            np.log(np.asarray(jax.nn.softmax(logits)))[np.arange(8), np.asarray(labels)]
+        )
+        np.testing.assert_allclose(float(cross_entropy(logits, labels)), expected, rtol=1e-5)
+
+    def test_masked_ce_ignores_pad(self, rng):
+        logits = jnp.asarray(rng.standard_normal((2, 6, 5)), dtype=jnp.float32)
+        labels = jnp.asarray([[1, 2, 3, 0, 0, 0], [4, 1, 0, 0, 0, 0]])
+        loss = masked_token_cross_entropy(logits, labels, pad_id=0)
+        # Equals the mean CE over just the 5 non-pad tokens
+        # (pytorch_machine_translator.py:182-188 semantics).
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        mask = np.asarray(labels) != 0
+        expected = float(np.asarray(per_tok)[mask].mean())
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+    def test_masked_ce_pad_logits_irrelevant(self, rng):
+        logits = jnp.asarray(rng.standard_normal((1, 4, 5)), dtype=jnp.float32)
+        labels = jnp.asarray([[2, 1, 0, 0]])
+        loss1 = masked_token_cross_entropy(logits, labels)
+        logits2 = logits.at[0, 2:].add(37.0)
+        loss2 = masked_token_cross_entropy(logits2, labels)
+        np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+
+
+def _synthetic_classification(rng, n=120, features=4, classes=3):
+    """4-feature/3-class data shaped like the MLlib libsvm sample
+    (mllib_multilayer_perceptron_classifier.py:32) — linearly separable-ish."""
+    centers = rng.standard_normal((classes, features)) * 3
+    labels = rng.integers(0, classes, n)
+    feats = centers[labels] + rng.standard_normal((n, features)) * 0.5
+    return feats.astype(np.float32), labels.astype(np.int64)
+
+
+def _batches(features, labels, batch_size):
+    out = []
+    for i in range(0, len(labels), batch_size):
+        out.append((jnp.asarray(features[i : i + batch_size]),
+                    jnp.asarray(labels[i : i + batch_size])))
+    return out
+
+
+class TestFitMLP:
+    """The minimum end-to-end slice (SURVEY.md §7 step 2): MLP 4-5-4-3,
+    sigmoid, SGD(0.03), CE — mirrors pytorch_multilayer_perceptron.py."""
+
+    def test_loss_decreases_and_learns(self, rng):
+        feats, labels = _synthetic_classification(rng)
+        model = MLP(layers=(4, 5, 4, 3))
+        params = model.init(jax.random.key(0), feats[:1])["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.03)
+        )
+        loss_fn = classification_loss(model.apply)
+        batches = _batches(feats, labels, 30)
+        result = fit(state, loss_fn, batches, epochs=100, log_every=0)
+        assert result.history[-1]["loss"] < result.history[0]["loss"]
+        metrics = evaluate(result.state, loss_fn, batches, emit=lambda s: None)
+        assert metrics["accuracy"] > 80.0
+        assert result.train_seconds > 0
+
+    def test_step_counter_advances(self, rng):
+        feats, labels = _synthetic_classification(rng, n=30)
+        model = MLP(layers=(4, 5, 4, 3))
+        params = model.init(jax.random.key(0), feats[:1])["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.03)
+        )
+        result = fit(state, classification_loss(model.apply), _batches(feats, labels, 30),
+                     epochs=3, log_every=0)
+        assert int(result.state.step) == 3
+
+
+class TestFitCNN:
+    def test_loss_decreases(self, rng):
+        # Tiny synthetic FashionMNIST-shaped batch; 20 steps of SGD(0.01).
+        images = rng.standard_normal((32, 28, 28, 1)).astype(np.float32)
+        labels = rng.integers(0, 10, 32).astype(np.int64)
+        model = TinyVGG(hidden_units=4, num_classes=10)
+        params = model.init(jax.random.key(0), jnp.asarray(images[:1]))["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.05)
+        )
+        batches = [(jnp.asarray(images), jnp.asarray(labels))]
+        result = fit(state, classification_loss(model.apply), batches,
+                     epochs=20, log_every=0)
+        assert result.history[-1]["loss"] < result.history[0]["loss"] * 0.9
+
+
+class TestFitLSTM:
+    def test_loss_decreases(self, rng):
+        # Token sequences whose class is determined by the dominant token id
+        # band — learnable by the embedding alone.
+        n, seq, vocab, classes = 64, 12, 40, 4
+        labels = rng.integers(0, classes, n)
+        toks = np.stack([
+            rng.integers(lbl * 10, lbl * 10 + 10, seq) for lbl in labels
+        ]).astype(np.int32)
+        model = LSTMClassifier(vocab_size=vocab, embed_dim=8, hidden_size=16,
+                               num_classes=classes)
+        params = model.init(jax.random.key(0), jnp.asarray(toks[:1]))["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("adam", 1e-2)
+        )
+        loss_fn = classification_loss(model.apply, last_timestep=True)
+        batches = [(jnp.asarray(toks), jnp.asarray(labels.astype(np.int64)))]
+        result = fit(state, loss_fn, batches, epochs=30, log_every=0)
+        assert result.history[-1]["loss"] < result.history[0]["loss"] * 0.5
