@@ -1,5 +1,36 @@
+"""data — ingestion (libsvm/image/text), sampling, loading (reference L1-L3)."""
+
 from machine_learning_apache_spark_tpu.data.frame import ArrayFrame
 from machine_learning_apache_spark_tpu.data.libsvm import read_libsvm, write_libsvm
 from machine_learning_apache_spark_tpu.data.reader import DataReader
+from machine_learning_apache_spark_tpu.data.sampler import DistributedSampler
+from machine_learning_apache_spark_tpu.data.loader import (
+    ArrayDataset,
+    DataLoader,
+    random_split,
+)
+from machine_learning_apache_spark_tpu.data.datasets import (
+    load_ag_news,
+    load_fashion_mnist,
+    load_multi30k,
+    synthetic_image_classification,
+    synthetic_text_classification,
+    synthetic_translation_pairs,
+)
 
-__all__ = ["ArrayFrame", "read_libsvm", "write_libsvm", "DataReader"]
+__all__ = [
+    "ArrayFrame",
+    "read_libsvm",
+    "write_libsvm",
+    "DataReader",
+    "DistributedSampler",
+    "ArrayDataset",
+    "DataLoader",
+    "random_split",
+    "load_ag_news",
+    "load_fashion_mnist",
+    "load_multi30k",
+    "synthetic_image_classification",
+    "synthetic_text_classification",
+    "synthetic_translation_pairs",
+]

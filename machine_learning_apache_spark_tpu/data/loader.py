@@ -1,0 +1,126 @@
+"""Batch loader: host arrays → fixed-shape device-ready batches.
+
+The reference's DataLoaders (batch=30/32, ``pytorch_multilayer_perceptron.py:76-81``)
+iterate torch tensors; here batches are numpy views stacked to *static shapes*
+(XLA recompiles per shape — ragged tails either drop or pad, never retrace).
+
+TPU-first delta (SURVEY.md §7 hard parts): all preprocessing happens at
+construction/collation time on the host, never inside the step; the loop
+overlaps host batch prep with device compute because the jitted step is
+dispatched async.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+from machine_learning_apache_spark_tpu.data.sampler import DistributedSampler
+
+
+class ArrayDataset:
+    """``TensorDataset`` equivalent (``pytorch_multilayer_perceptron.py:70``):
+    parallel arrays indexed together."""
+
+    def __init__(self, *arrays: np.ndarray) -> None:
+        if not arrays:
+            raise ValueError("need at least one array")
+        n = len(arrays[0])
+        if any(len(a) != n for a in arrays):
+            raise ValueError(f"length mismatch: {[len(a) for a in arrays]}")
+        self.arrays = tuple(np.asarray(a) for a in arrays)
+
+    def __len__(self) -> int:
+        return len(self.arrays[0])
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.arrays)
+
+
+def random_split(
+    dataset: ArrayDataset, lengths_or_fracs: Sequence[float], seed: int = 0
+) -> list[ArrayDataset]:
+    """``torch.utils.data.random_split`` equivalent
+    (``pytorch_multilayer_perceptron.py:73`` does a 60/40 split)."""
+    n = len(dataset)
+    fracs = np.asarray(lengths_or_fracs, dtype=np.float64)
+    if fracs.sum() > 1.0 + 1e-9:  # absolute lengths given
+        sizes = fracs.astype(int)
+        if sizes.sum() != n:
+            raise ValueError(f"lengths {sizes.tolist()} != dataset size {n}")
+    else:
+        sizes = (fracs / fracs.sum() * n).astype(int)
+        sizes[-1] = n - sizes[:-1].sum()
+    perm = np.random.default_rng(seed).permutation(n)
+    out, start = [], 0
+    for s in sizes:
+        idx = perm[start : start + s]
+        out.append(ArrayDataset(*(a[idx] for a in dataset.arrays)))
+        start += s
+    return out
+
+
+class DataLoader:
+    """Minibatch iterator over an ArrayDataset.
+
+    - ``sampler``: a DistributedSampler for rank-sliced epochs; otherwise an
+      internal (optionally shuffled) full-range order.
+    - ``drop_last=True`` keeps every batch the same shape (one XLA program).
+    - ``collate``: optional ``fn(tuple_of_arrays) -> batch pytree`` applied per
+      batch on the host (the tokenize-outside-the-step seam; the reference
+      tokenizes *inside* its hot loop, ``pytorch_lstm.py:148``).
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        batch_size: int,
+        *,
+        shuffle: bool = False,
+        sampler: DistributedSampler | None = None,
+        drop_last: bool = True,
+        seed: int = 0,
+        collate: Callable[[tuple], Any] | None = None,
+    ) -> None:
+        if shuffle and sampler is not None:
+            raise ValueError(
+                "shuffle and sampler are mutually exclusive; give the sampler "
+                "shuffle=True instead"
+            )
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.sampler = sampler
+        self.drop_last = drop_last
+        self.seed = seed
+        self.collate = collate
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        if self.sampler is not None:
+            self.sampler.set_epoch(epoch)
+
+    def _order(self) -> np.ndarray:
+        if self.sampler is not None:
+            return np.fromiter(iter(self.sampler), dtype=np.int64)
+        if self.shuffle:
+            return np.random.default_rng(self.seed + self._epoch).permutation(
+                len(self.dataset)
+            )
+        return np.arange(len(self.dataset))
+
+    def __iter__(self) -> Iterator:
+        order = self._order()
+        stop = (
+            len(order) - self.batch_size + 1 if self.drop_last else len(order)
+        )
+        for start in range(0, max(stop, 0), self.batch_size):
+            idx = order[start : start + self.batch_size]
+            batch = self.dataset[idx]
+            yield self.collate(batch) if self.collate else batch
+
+    def __len__(self) -> int:
+        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)

@@ -1,6 +1,21 @@
+"""launcher — gang spawn + rendezvous (the TorchDistributor layer, C12)."""
+
 from machine_learning_apache_spark_tpu.launcher.coordinator import (
     RendezvousSpec,
     initialize_from_env,
+    shutdown,
+)
+from machine_learning_apache_spark_tpu.launcher.distributor import (
+    Distributor,
+    TorchDistributor,
+    fn_reference,
 )
 
-__all__ = ["RendezvousSpec", "initialize_from_env"]
+__all__ = [
+    "RendezvousSpec",
+    "initialize_from_env",
+    "shutdown",
+    "Distributor",
+    "TorchDistributor",
+    "fn_reference",
+]

@@ -1,0 +1,251 @@
+"""Distributor — the TorchDistributor equivalent (reference C12).
+
+The reference launches distributed training with
+``TorchDistributor(num_processes=executors_n, local_mode=..., use_gpu=False)
+.run(train_func)`` (``distributed_cnn.py:227-231``): Spark gang-schedules one
+barrier task per process, sets the torch rendezvous env vars, pickles
+``train_func`` with its module globals, and returns rank 0's result.
+
+Design deltas (SURVEY.md §7 design stance):
+
+- **Function by reference, not pickle-by-value**: the train function must be
+  importable (``module:qualname`` or a module-level callable). This kills the
+  reference's accidental re-execution of module-level downloads on every
+  executor (quirk Q13) — each worker imports the module once, deliberately.
+- **Rendezvous**: the launcher picks a free coordinator port and writes the
+  ``{MLSPARK_COORDINATOR, NUM_PROCESSES, PROCESS_ID}`` env contract (plus the
+  torch-style aliases) that ``launcher.coordinator`` maps onto
+  ``jax.distributed.initialize`` (SURVEY.md §2.4).
+- **Result**: rank 0's return value is actually returned (the reference's
+  ``train_func``s return None yet assign the result — quirk Q7).
+- **Gang failure semantics**: any worker dying kills the gang and raises —
+  the Spark-barrier all-or-nothing behavior (SURVEY.md §5 failure detection).
+
+``local_mode=True`` (the reference's bring-up path,
+``distributed_multilayer_perceptron.py:179``) spawns all ranks on this host.
+Multi-host mode emits the per-host command lines instead (control-plane
+integration with an external scheduler; see ``commands_for_hosts``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def fn_reference(fn: Callable | str) -> str:
+    """``module:qualname`` reference for an importable function."""
+    if isinstance(fn, str):
+        if ":" not in fn:
+            raise ValueError(f"function reference must be 'module:qualname', got {fn!r}")
+        return fn
+    module = getattr(fn, "__module__", None)
+    qualname = getattr(fn, "__qualname__", None)
+    if not module or not qualname or "<" in qualname:
+        raise ValueError(
+            f"{fn!r} is not an importable module-level function; the launcher "
+            "runs functions by reference (no closure pickling — SURVEY.md Q13)"
+        )
+    return f"{module}:{qualname}"
+
+
+def resolve_fn(ref: str) -> Callable:
+    """Import a ``module:qualname`` reference (shared by Distributor and the
+    per-worker runner)."""
+    import importlib
+
+    module, _, qual = fn_reference(ref).partition(":")
+    obj: Any = importlib.import_module(module)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+@dataclass
+class WorkerResult:
+    rank: int
+    value: Any = None
+    error: str | None = None
+
+
+class Distributor:
+    """``Distributor(num_processes=N, local_mode=True).run(train_fn, *args)``.
+
+    ``use_gpu`` is accepted for API parity with TorchDistributor and ignored
+    (the accelerator is whatever the JAX platform provides; the reference
+    always passed ``use_gpu=False`` anyway, ``distributed_cnn.py:230``).
+    """
+
+    def __init__(
+        self,
+        num_processes: int | None = None,
+        *,
+        local_mode: bool = True,
+        use_gpu: bool = False,  # noqa: ARG002 - API parity
+        platform: str | None = None,
+        env: dict[str, str] | None = None,
+        timeout: float = 600.0,
+    ) -> None:
+        self.num_processes = num_processes or 1
+        self.local_mode = local_mode
+        self.platform = platform
+        self.extra_env = env or {}
+        self.timeout = timeout
+
+    # -- multi-host control plane --------------------------------------------
+    def commands_for_hosts(
+        self, fn: Callable | str, hosts: Sequence[str], coordinator_port: int = 29500
+    ) -> list[str]:
+        """One launch command per host for an external scheduler (the analogue
+        of spark-submit's role): host 0 is the coordinator."""
+        ref = fn_reference(fn)
+        coord = f"{hosts[0]}:{coordinator_port}"
+        return [
+            sys.executable
+            + " -m machine_learning_apache_spark_tpu.launcher.runner"
+            + f" --fn {ref} --coordinator {coord}"
+            + f" --num-processes {len(hosts)} --process-id {rank}"
+            for rank, _ in enumerate(hosts)
+        ]
+
+    # -- local gang spawn ----------------------------------------------------
+    def run(self, fn: Callable | str, *args: Any, **kwargs: Any) -> Any:
+        """Spawn the gang, wait, return rank 0's result
+        (``distributor.run(train_func)`` contract, ``distributed_cnn.py:231``)."""
+        if not self.local_mode:
+            raise RuntimeError(
+                "cluster mode is driven by an external scheduler: use "
+                "commands_for_hosts() to obtain per-host launch commands"
+            )
+        n = self.num_processes
+        if n == 1:
+            # Single process: run inline, as the reference's sequential
+            # scripts do (no rendezvous needed).
+            fn = self._resolve(fn)
+            return fn(*args, **kwargs)
+
+        ref = fn_reference(fn)
+        coord = f"127.0.0.1:{_free_port()}"
+        workdir = tempfile.mkdtemp(prefix="mlspark_gang_")
+        args_path = os.path.join(workdir, "args.pkl")
+        with open(args_path, "wb") as f:
+            pickle.dump((args, kwargs), f)
+
+        try:
+            return self._run_gang(ref, coord, workdir, args_path, n)
+        finally:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    def _run_gang(
+        self, ref: str, coord: str, workdir: str, args_path: str, n: int
+    ) -> Any:
+        procs: list[subprocess.Popen] = []
+        result_paths = []
+        for rank in range(n):
+            result_path = os.path.join(workdir, f"result_{rank}.pkl")
+            result_paths.append(result_path)
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            env["MLSPARK_COORDINATOR"] = coord
+            env["MLSPARK_NUM_PROCESSES"] = str(n)
+            env["MLSPARK_PROCESS_ID"] = str(rank)
+            host, _, port = coord.partition(":")
+            env["MASTER_ADDR"], env["MASTER_PORT"] = host, port
+            env["WORLD_SIZE"], env["RANK"] = str(n), str(rank)
+            if self.platform:
+                # Both forms: the env var for vanilla images, MLSPARK_PLATFORM
+                # for the runner's config-API override (the axon sitecustomize
+                # ignores JAX_PLATFORMS — see runner.main).
+                env["JAX_PLATFORMS"] = self.platform
+                env["MLSPARK_PLATFORM"] = self.platform
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in sys.path if p
+            )
+            cmd = [
+                sys.executable,
+                "-m",
+                "machine_learning_apache_spark_tpu.launcher.runner",
+                "--fn", ref,
+                "--args-file", args_path,
+                "--result-file", result_path,
+            ]
+            procs.append(subprocess.Popen(cmd, env=env))
+        log.info("spawned %d-process gang (coordinator %s)", n, coord)
+
+        deadline = time.monotonic() + self.timeout
+        try:
+            self._wait_gang(procs, deadline)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+        results = [self._read_result(path, rank) for rank, path in enumerate(result_paths)]
+        errors = [r for r in results if r.error]
+        if errors:
+            # Ranks killed by the gang teardown leave placeholder errors;
+            # surface the rank that actually crashed (its real traceback).
+            primary = next(
+                (r for r in errors if "produced no result" not in r.error), errors[0]
+            )
+            raise RuntimeError(
+                "gang failed on rank(s) "
+                + ", ".join(str(r.rank) for r in errors)
+                + f":\n[rank {primary.rank}] {primary.error}"
+            )
+        return results[0].value
+
+    def _wait_gang(self, procs: list[subprocess.Popen], deadline: float) -> None:
+        """All-or-nothing barrier semantics: first nonzero exit kills the gang."""
+        pending = set(range(len(procs)))
+        while pending:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"gang did not finish within {self.timeout}s; killing"
+                )
+            for rank in list(pending):
+                code = procs[rank].poll()
+                if code is None:
+                    continue
+                pending.discard(rank)
+                if code != 0:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    # fall through: result files carry the traceback
+            time.sleep(0.05)
+
+    @staticmethod
+    def _resolve(fn: Callable | str) -> Callable:
+        return fn if callable(fn) else resolve_fn(fn)
+
+    @staticmethod
+    def _read_result(path: str, rank: int) -> WorkerResult:
+        if not os.path.exists(path):
+            return WorkerResult(rank=rank, error=f"rank {rank} produced no result (crashed?)")
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+# API-parity alias: reference user code says TorchDistributor
+# (distributed_cnn.py:227).
+TorchDistributor = Distributor

@@ -1,0 +1,91 @@
+"""Per-worker entry point for the Distributor gang.
+
+Keep module-scope imports stdlib-only: this module is imported in every
+spawned worker *before* the JAX platform choice is settled, and the heavy
+framework import happens only after the rendezvous env is in place.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+import traceback
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--fn", required=True, help="module:qualname")
+    parser.add_argument("--args-file", default=None)
+    parser.add_argument("--result-file", default=None)
+    parser.add_argument("--coordinator", default=None)
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    ns = parser.parse_args(argv)
+
+    # CLI rendezvous flags (multi-host path) take precedence over env.
+    if ns.coordinator:
+        os.environ["MLSPARK_COORDINATOR"] = ns.coordinator
+    if ns.num_processes is not None:
+        os.environ["MLSPARK_NUM_PROCESSES"] = str(ns.num_processes)
+    if ns.process_id is not None:
+        os.environ["MLSPARK_PROCESS_ID"] = str(ns.process_id)
+
+    rank = int(os.environ.get("MLSPARK_PROCESS_ID", "0"))
+    args, kwargs = ((), {})
+    if ns.args_file:
+        with open(ns.args_file, "rb") as f:
+            args, kwargs = pickle.load(f)
+
+    result: dict = {"rank": rank, "value": None, "error": None}
+    code = 0
+    try:
+        # Platform choice must go through the config API: the hosting image's
+        # sitecustomize registers the axon TPU plugin in every process and
+        # the JAX_PLATFORMS env var alone does not stick (see
+        # tests/conftest.py). Must happen before any backend/device touch.
+        platform = os.environ.get("MLSPARK_PLATFORM")
+        if platform:
+            import jax
+
+            jax.config.update("jax_platforms", platform)
+
+        # Rendezvous before user code touches devices — the
+        # dist.init_process_group analogue (distributed_cnn.py:152).
+        from machine_learning_apache_spark_tpu.launcher.coordinator import (
+            initialize_from_env,
+        )
+
+        initialize_from_env()
+
+        from machine_learning_apache_spark_tpu.launcher.distributor import (
+            resolve_fn,
+        )
+
+        result["value"] = resolve_fn(ns.fn)(*args, **kwargs)
+    except BaseException:  # noqa: BLE001 - worker must report, not die silently
+        result["error"] = traceback.format_exc()
+        code = 1
+    finally:
+        if ns.result_file:
+            from machine_learning_apache_spark_tpu.launcher.distributor import (
+                WorkerResult,
+            )
+
+            payload = WorkerResult(**result)
+            if code == 0 and rank != 0:
+                # Only rank 0's value crosses back (distributor.run contract,
+                # distributed_cnn.py:231); other ranks report success only.
+                payload.value = None
+            try:
+                with open(ns.result_file, "wb") as f:
+                    pickle.dump(payload, f)
+            except Exception:
+                traceback.print_exc()
+                code = code or 1
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,13 +1,46 @@
+"""parallel — mesh construction and parallelism strategies (SURVEY.md §2.3).
+
+- data parallel: the parity-required strategy (the reference's only one).
+- tensor parallel: logical-axis param sharding over mesh axis ``"model"``.
+- sequence parallel: ring attention over mesh axis ``"seq"``.
+"""
+
 from machine_learning_apache_spark_tpu.parallel.mesh import (
-    make_mesh,
-    data_parallel_mesh,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    SEQ_AXIS,
     batch_sharding,
+    data_parallel_mesh,
+    make_mesh,
+    replicate,
     replicated_sharding,
+    shard_batch,
+)
+from machine_learning_apache_spark_tpu.parallel.data_parallel import (
+    assert_replicas_in_sync,
+    make_data_parallel_eval_step,
+    make_data_parallel_step,
+    pad_batch_to_multiple,
+    params_fingerprint,
 )
 
 __all__ = [
-    "make_mesh",
-    "data_parallel_mesh",
+    "DATA_AXIS",
+    "EXPERT_AXIS",
+    "MODEL_AXIS",
+    "PIPELINE_AXIS",
+    "SEQ_AXIS",
     "batch_sharding",
+    "data_parallel_mesh",
+    "make_mesh",
+    "replicate",
     "replicated_sharding",
+    "shard_batch",
+    "assert_replicas_in_sync",
+    "make_data_parallel_eval_step",
+    "make_data_parallel_step",
+    "pad_batch_to_multiple",
+    "params_fingerprint",
 ]

@@ -1,0 +1,31 @@
+"""Importable worker functions for launcher tests (the launcher runs
+functions by reference — they must live in a real module, which is itself
+the Q13-fix behavior under test)."""
+
+import os
+
+
+def echo_rank(tag="none"):
+    return {
+        "rank": int(os.environ.get("MLSPARK_PROCESS_ID", "-1")),
+        "world": int(os.environ.get("MLSPARK_NUM_PROCESSES", "-1")),
+        "master": os.environ.get("MASTER_ADDR"),
+        "tag": tag,
+    }
+
+
+def boom():
+    raise RuntimeError("worker exploded (intentional)")
+
+
+def cross_process_sum():
+    """Verifies jax.distributed actually rendezvoused: allgather each rank's
+    value and sum — the collective path the reference delegates to gloo."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    rank = jax.process_index()
+    world = jax.process_count()
+    gathered = multihost_utils.process_allgather(jnp.asarray([rank + 1.0]))
+    return {"rank": rank, "world": world, "sum": float(gathered.sum())}

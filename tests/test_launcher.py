@@ -1,0 +1,90 @@
+"""Launcher tests (SURVEY.md §4): spawn N local processes, verify rendezvous
+env plumb-through, rank/world assignment, rank-0 result return, and gang
+failure propagation."""
+
+import pytest
+
+from machine_learning_apache_spark_tpu.launcher import Distributor, fn_reference
+from machine_learning_apache_spark_tpu.launcher.coordinator import RendezvousSpec
+
+
+class TestFnReference:
+    def test_module_function(self):
+        from launcher_workers import echo_rank
+
+        assert fn_reference(echo_rank) == "launcher_workers:echo_rank"
+
+    def test_lambda_rejected(self):
+        with pytest.raises(ValueError):
+            fn_reference(lambda: None)
+
+    def test_string_passthrough(self):
+        assert fn_reference("a.b:c") == "a.b:c"
+        with pytest.raises(ValueError):
+            fn_reference("no_colon")
+
+
+class TestRendezvousSpec:
+    def test_torch_style_env(self, monkeypatch):
+        monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "1234")
+        monkeypatch.setenv("WORLD_SIZE", "4")
+        monkeypatch.setenv("RANK", "2")
+        spec = RendezvousSpec.from_env()
+        assert spec.coordinator_address == "10.0.0.1:1234"
+        assert spec.num_processes == 4 and spec.process_id == 2
+
+    def test_single_process_is_none(self, monkeypatch):
+        for var in ("MASTER_ADDR", "MLSPARK_COORDINATOR", "WORLD_SIZE"):
+            monkeypatch.delenv(var, raising=False)
+        assert RendezvousSpec.from_env() is None
+
+    def test_apply_env_roundtrip(self):
+        spec = RendezvousSpec("h:29500", 8, 3)
+        env = spec.apply_env({})
+        assert env["MASTER_ADDR"] == "h" and env["RANK"] == "3"
+        assert env["MLSPARK_NUM_PROCESSES"] == "8"
+
+
+class TestDistributorLocal:
+    def test_single_process_inline(self):
+        from launcher_workers import echo_rank
+
+        out = Distributor(num_processes=1).run(echo_rank, tag="inline")
+        assert out["tag"] == "inline"
+
+    def test_gang_rank0_result(self):
+        # 2-process gang: rank 0's dict comes back with correct rank/world env.
+        out = Distributor(num_processes=2, platform="cpu", timeout=120).run(
+            "launcher_workers:echo_rank", tag="gang"
+        )
+        assert out == {"rank": 0, "world": 2, "master": "127.0.0.1", "tag": "gang"}
+
+    def test_gang_failure_raises(self):
+        with pytest.raises(RuntimeError, match="worker exploded"):
+            Distributor(num_processes=2, platform="cpu", timeout=120).run(
+                "launcher_workers:boom"
+            )
+
+    @pytest.mark.slow
+    def test_gang_jax_distributed_collective(self):
+        # Full rendezvous: 2 CPU processes jax.distributed.initialize and
+        # allgather — the gloo-collective parity check (SURVEY.md §2.4).
+        out = Distributor(num_processes=2, platform="cpu", timeout=240).run(
+            "launcher_workers:cross_process_sum"
+        )
+        assert out == {"rank": 0, "world": 2, "sum": 3.0}
+
+
+class TestCommandsForHosts:
+    def test_command_lines(self):
+        cmds = Distributor(local_mode=False).commands_for_hosts(
+            "launcher_workers:echo_rank", ["tpu-host-0", "tpu-host-1"]
+        )
+        assert len(cmds) == 2
+        assert "--coordinator tpu-host-0:29500" in cmds[0]
+        assert "--process-id 1" in cmds[1]
+
+    def test_cluster_run_raises(self):
+        with pytest.raises(RuntimeError, match="commands_for_hosts"):
+            Distributor(local_mode=False).run("launcher_workers:echo_rank")
