@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Benchmark — flagship Transformer MT workload, tokens/sec/chip.
+
+Protocol per BASELINE.md: the reference publishes no numbers; its contract is
+self-timed training throughput (``pytorch_machine_translator.py:199-205``
+times batches of 32 × 200-token sentences). Here the same workload (reference
+hypers: d_model=512, ffn=1024, heads=8, layers=1, seq=200, batch=32/chip,
+Multi30k-scale vocabs) runs as a data-parallel jitted train step in bfloat16,
+and ``vs_baseline`` is the ratio against the reference-equivalent PyTorch
+model (torch.nn.Transformer, same shapes, Adam) measured on CPU in-process —
+the reference's own engine on the hardware it targets (CPU-only end to end,
+SURVEY.md §3 observation b).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("BENCH_PLATFORM"):  # e.g. "cpu" for hardware-free smoke runs
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from machine_learning_apache_spark_tpu.models import Transformer, TransformerConfig
+from machine_learning_apache_spark_tpu.parallel import DATA_AXIS, make_mesh, shard_params
+from machine_learning_apache_spark_tpu.train.losses import masked_token_cross_entropy
+from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
+
+SEQ = 200
+BATCH_PER_CHIP = int(os.environ.get("BENCH_BATCH", "32"))
+SRC_VOCAB = 8192
+TRG_VOCAB = 10240
+WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_jax() -> float:
+    n_chips = jax.device_count()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = TransformerConfig(
+        src_vocab_size=SRC_VOCAB,
+        trg_vocab_size=TRG_VOCAB,
+        max_len=SEQ,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = Transformer(cfg)
+    mesh = make_mesh({DATA_AXIS: n_chips})
+    batch = BATCH_PER_CHIP * n_chips
+
+    rng = jax.random.key(0)
+    src = jax.random.randint(rng, (batch, SEQ), 1, SRC_VOCAB, dtype=jnp.int32)
+    trg = jax.random.randint(rng, (batch, SEQ), 1, TRG_VOCAB, dtype=jnp.int32)
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    src, trg = jax.device_put(src, sharding), jax.device_put(trg, sharding)
+
+    params = shard_params(model.init(jax.random.key(1), src[:2], trg[:2])["params"], mesh)
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_optimizer("adam", 1e-3)
+    )
+
+    def loss_fn(params, src, trg, rng):
+        logits = model.apply(
+            {"params": params},
+            src,
+            trg[:, :-1],
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        return masked_token_cross_entropy(logits, trg[:, 1:], cfg.pad_id)
+
+    @jax.jit
+    def step(state, src, trg, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, src, trg, rng)
+        return state.apply_gradients(grads), loss
+
+    rngs = jax.random.split(jax.random.key(2), WARMUP + STEPS)
+    for i in range(WARMUP):
+        state, loss = step(state, src, trg, rngs[i])
+    jax.block_until_ready(state.params)
+    log(f"jax warmup done on {n_chips} × {jax.devices()[0].platform}")
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, loss = step(state, src, trg, rngs[WARMUP + i])
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * SEQ * STEPS  # target tokens trained on
+    tps_chip = tokens / dt / n_chips
+    log(f"jax: {STEPS} steps in {dt:.3f}s → {tps_chip:,.0f} tokens/sec/chip "
+        f"(loss {float(loss):.3f})")
+    return tps_chip
+
+
+def bench_torch_baseline() -> float | None:
+    """Reference-equivalent engine: torch.nn.Transformer, same shapes, CPU."""
+    if os.environ.get("BENCH_SKIP_TORCH"):
+        return None
+    try:
+        import torch
+        import torch.nn as tnn
+
+        torch.manual_seed(0)
+        d, steps = 512, int(os.environ.get("BENCH_TORCH_STEPS", "3"))
+        batch = min(BATCH_PER_CHIP, 32)
+
+        class Ref(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.src_emb = tnn.Embedding(SRC_VOCAB, d)
+                self.trg_emb = tnn.Embedding(TRG_VOCAB, d)
+                self.core = tnn.Transformer(
+                    d_model=d, nhead=8, num_encoder_layers=1,
+                    num_decoder_layers=1, dim_feedforward=1024,
+                    dropout=0.1, batch_first=True,
+                )
+                self.head = tnn.Linear(d, TRG_VOCAB)
+
+            def forward(self, src, trg):
+                mask = tnn.Transformer.generate_square_subsequent_mask(trg.shape[1])
+                return self.head(
+                    self.core(self.src_emb(src), self.trg_emb(trg), tgt_mask=mask)
+                )
+
+        model = Ref()
+        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+        loss_fn = tnn.CrossEntropyLoss(ignore_index=0)
+        src = torch.randint(1, SRC_VOCAB, (batch, SEQ))
+        trg = torch.randint(1, TRG_VOCAB, (batch, SEQ))
+
+        def one_step():
+            opt.zero_grad()
+            logits = model(src, trg[:, :-1])
+            loss = loss_fn(logits.reshape(-1, TRG_VOCAB), trg[:, 1:].reshape(-1))
+            loss.backward()
+            opt.step()
+
+        one_step()  # warmup
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            one_step()
+        dt = time.perf_counter() - t0
+        tps = batch * SEQ * steps / dt
+        log(f"torch-cpu baseline: {steps} steps in {dt:.3f}s → {tps:,.0f} tokens/sec")
+        return tps
+    except Exception as e:  # baked-in torch should work; degrade gracefully
+        log(f"torch baseline unavailable: {e!r}")
+        return None
+
+
+def main() -> None:
+    value = bench_jax()
+    baseline = bench_torch_baseline()
+    vs = value / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": "transformer_mt_train_throughput",
+        "value": round(value, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,14 +42,25 @@ def random_split(
     dataset: ArrayDataset, lengths_or_fracs: Sequence[float], seed: int = 0
 ) -> list[ArrayDataset]:
     """``torch.utils.data.random_split`` equivalent
-    (``pytorch_multilayer_perceptron.py:73`` does a 60/40 split)."""
+    (``pytorch_multilayer_perceptron.py:73`` does a 60/40 split).
+
+    torch semantics for disambiguation: integer entries are absolute lengths,
+    float entries are fractions — never guessed from the sum (``[1]`` on a
+    10-row dataset means one split of length 1, not 100%).
+    """
     n = len(dataset)
-    fracs = np.asarray(lengths_or_fracs, dtype=np.float64)
-    if fracs.sum() > 1.0 + 1e-9:  # absolute lengths given
-        sizes = fracs.astype(int)
+    values = np.asarray(lengths_or_fracs)
+    if np.issubdtype(values.dtype, np.integer):  # absolute lengths given
+        sizes = values.astype(int)
         if sizes.sum() != n:
             raise ValueError(f"lengths {sizes.tolist()} != dataset size {n}")
     else:
+        fracs = values.astype(np.float64)
+        if fracs.sum() > 1.0 + 1e-9:
+            raise ValueError(
+                f"fractions {fracs.tolist()} sum to {fracs.sum()} > 1; pass "
+                "integers for absolute lengths"
+            )
         sizes = (fracs / fracs.sum() * n).astype(int)
         sizes[-1] = n - sizes[:-1].sum()
     perm = np.random.default_rng(seed).permutation(n)

@@ -135,9 +135,11 @@ class Distributor:
                 "commands_for_hosts() to obtain per-host launch commands"
             )
         n = self.num_processes
-        if n == 1:
+        if n == 1 and not (self.platform or self.extra_env):
             # Single process: run inline, as the reference's sequential
-            # scripts do (no rendezvous needed).
+            # scripts do (no rendezvous needed). With platform/env overrides
+            # we must still spawn (they only apply to a fresh interpreter —
+            # this one's JAX backend may already be initialized).
             fn = self._resolve(fn)
             return fn(*args, **kwargs)
 
@@ -242,8 +244,16 @@ class Distributor:
     def _read_result(path: str, rank: int) -> WorkerResult:
         if not os.path.exists(path):
             return WorkerResult(rank=rank, error=f"rank {rank} produced no result (crashed?)")
-        with open(path, "rb") as f:
-            return pickle.load(f)
+        try:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        except Exception as e:
+            # Truncated/corrupt file (e.g. the worker died mid-dump, or its
+            # return value wasn't picklable): treat as a worker failure so the
+            # gang error carries the rank, not a bare unpickling traceback.
+            return WorkerResult(
+                rank=rank, error=f"rank {rank} produced no result (unreadable result file: {e!r})"
+            )
 
 
 # API-parity alias: reference user code says TorchDistributor

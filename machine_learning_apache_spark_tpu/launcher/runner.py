@@ -82,8 +82,20 @@ def main(argv: list[str] | None = None) -> int:
                 with open(ns.result_file, "wb") as f:
                     pickle.dump(payload, f)
             except Exception:
+                # Unpicklable return value: replace the (possibly truncated)
+                # file with an error result so the driver reports this rank's
+                # real failure rather than an unpickling artifact.
                 traceback.print_exc()
                 code = code or 1
+                payload = WorkerResult(
+                    rank=rank,
+                    error=f"rank {rank} result not picklable:\n{traceback.format_exc()}",
+                )
+                try:
+                    with open(ns.result_file, "wb") as f:
+                        pickle.dump(payload, f)
+                except Exception:
+                    traceback.print_exc()
     return code
 
 

@@ -25,6 +25,13 @@ from machine_learning_apache_spark_tpu.parallel.data_parallel import (
     pad_batch_to_multiple,
     params_fingerprint,
 )
+from machine_learning_apache_spark_tpu.parallel.tensor_parallel import (
+    DEFAULT_RULES,
+    logical_to_mesh_spec,
+    mesh_shardings,
+    shard_params,
+    with_sharding_constraint,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -43,4 +50,9 @@ __all__ = [
     "make_data_parallel_step",
     "pad_batch_to_multiple",
     "params_fingerprint",
+    "DEFAULT_RULES",
+    "logical_to_mesh_spec",
+    "mesh_shardings",
+    "shard_params",
+    "with_sharding_constraint",
 ]

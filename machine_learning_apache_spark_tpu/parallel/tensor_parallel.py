@@ -1,0 +1,107 @@
+"""Tensor parallelism — logical-axis param sharding over the mesh ``"model"`` axis.
+
+The reference has no TP (SURVEY.md §2.3: largest layer is ``Linear(512,
+de_vocab)``, ``transformer.py:271``); this module is the capability headroom
+the build contract asks for. The zoo's Transformer annotates every weight
+with *logical* axis names via ``nn.with_partitioning`` — ``("embed","heads")``
+on attention projections, ``("embed","mlp")`` on FFN, ``("embed","vocab")`` on
+the LM head. This module maps those logical names onto mesh axes and places
+params accordingly; XLA's sharding propagation then compiles the Megatron-style
+collectives (all-reduce after the row-parallel matmul) over ICI — nothing is
+hand-scheduled.
+
+Design note (scaling-book recipe): pick a mesh, annotate shardings on the
+*data*, let the compiler insert collectives. The train step itself is the
+plain jitted step from ``train.loop`` — TP changes only where arrays live.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from machine_learning_apache_spark_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+)
+
+# Logical axis name -> mesh axis name (None = replicated on that dim).
+# ``embed`` stays replicated: d_model is the contracting dim everywhere, so
+# sharding it would force an allreduce per matmul; sharding heads/mlp/vocab
+# gives the classic column→row parallel pairing with one psum per block.
+DEFAULT_RULES: dict[str, str | None] = {
+    "embed": None,
+    "heads": MODEL_AXIS,
+    "mlp": MODEL_AXIS,
+    "vocab": MODEL_AXIS,
+    "batch": DATA_AXIS,
+    "seq": SEQ_AXIS,
+}
+
+
+def logical_to_mesh_spec(
+    spec: P, mesh: Mesh, rules: Mapping[str, str | None] | None = None
+) -> P:
+    """Translate a PartitionSpec of logical names into mesh axis names.
+
+    Logical names with no rule, rules mapping to ``None``, and mesh axes not
+    present on this mesh all become unsharded dims — so the same annotated
+    model runs unchanged on a pure-DP mesh (specs collapse to replicated,
+    matching the reference's whole-replica DDP semantics).
+    """
+    rules = dict(DEFAULT_RULES if rules is None else rules)
+
+    def translate(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            axes = tuple(a for a in (translate(e) for e in entry) if a is not None)
+            return axes if axes else None
+        mesh_axis = rules.get(entry)
+        if mesh_axis is None or mesh_axis not in mesh.axis_names:
+            return None
+        return mesh_axis
+
+    return P(*(translate(e) for e in spec))
+
+
+def mesh_shardings(
+    tree: Any, mesh: Mesh, rules: Mapping[str, str | None] | None = None
+) -> Any:
+    """NamedSharding tree for a (possibly boxed) variable/param tree.
+
+    Boxed ``nn.Partitioned`` leaves contribute their logical spec; plain
+    arrays are replicated. Structure matches the *unboxed* tree.
+    """
+    specs = nn.get_partition_spec(tree)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, logical_to_mesh_spec(s, mesh, rules)),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(
+    params: Any, mesh: Mesh, rules: Mapping[str, str | None] | None = None
+) -> Any:
+    """Unbox a param tree and place every leaf per its logical annotation.
+
+    Returns plain arrays (metadata stripped): downstream code — the jitted
+    train step, optax — sees ordinary sharded ``jax.Array``s, and optimizer
+    state created from them inherits the same layout (optax init is
+    ``zeros_like``-shaped, which follows input sharding).
+    """
+    shardings = mesh_shardings(params, mesh, rules)
+    unboxed = nn.unbox(params)
+    return jax.tree.map(jax.device_put, unboxed, shardings)
+
+
+def with_sharding_constraint(x, mesh: Mesh, *names):
+    """Constrain an activation inside jit, tolerating absent mesh axes —
+    ``names`` are logical (``"batch"``, ``"seq"``, ``"heads"`` …)."""
+    spec = logical_to_mesh_spec(P(*names), mesh)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

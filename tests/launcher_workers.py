@@ -18,6 +18,10 @@ def boom():
     raise RuntimeError("worker exploded (intentional)")
 
 
+def unpicklable_result():
+    return lambda: None  # cannot cross the result-file boundary
+
+
 def cross_process_sum():
     """Verifies jax.distributed actually rendezvoused: allgather each rank's
     value and sum — the collective path the reference delegates to gloo."""

@@ -33,7 +33,6 @@ class TestDistributedSampler:
         s.set_epoch(1)
         second = list(s)
         assert first != second
-        assert sorted(first) != sorted(second) or set(first) != set(second) or True
         # same cardinality either way
         assert len(first) == len(second) == 32
 
@@ -113,6 +112,17 @@ class TestDataLoader:
         assert len(train) == 60 and len(test) == 40
         merged = sorted(train.arrays[0].tolist() + test.arrays[0].tolist())
         assert merged == list(range(100))
+
+    def test_random_split_absolute_lengths(self):
+        # torch semantics: int entries are absolute lengths, even if they sum
+        # to <= 1 per element count ([1, 9] or [1] must not be read as fracs).
+        ds = ArrayDataset(np.arange(10), np.arange(10))
+        a, b = random_split(ds, [1, 9], seed=0)
+        assert len(a) == 1 and len(b) == 9
+        with pytest.raises(ValueError, match="!= dataset size"):
+            random_split(ds, [1])
+        with pytest.raises(ValueError, match="sum to"):
+            random_split(ds, [0.9, 0.9])
 
 
 class TestSyntheticDatasets:

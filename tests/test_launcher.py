@@ -66,6 +66,23 @@ class TestDistributorLocal:
                 "launcher_workers:boom"
             )
 
+    def test_unpicklable_result_reports_rank_failure(self):
+        # A worker whose return value can't be pickled must surface as a gang
+        # failure naming the rank — not escape as a raw EOFError/unpickling
+        # artifact from a truncated result file.
+        with pytest.raises(RuntimeError, match="gang failed"):
+            Distributor(num_processes=2, platform="cpu", timeout=120).run(
+                "launcher_workers:unpicklable_result"
+            )
+
+    def test_single_process_with_platform_spawns(self):
+        # n=1 + platform override must not run inline (this interpreter's
+        # backend is already initialized) — it spawns and applies the env.
+        out = Distributor(num_processes=1, platform="cpu", timeout=120).run(
+            "launcher_workers:echo_rank", tag="spawned"
+        )
+        assert out["tag"] == "spawned" and out["rank"] == 0
+
     @pytest.mark.slow
     def test_gang_jax_distributed_collective(self):
         # Full rendezvous: 2 CPU processes jax.distributed.initialize and

@@ -1,0 +1,107 @@
+"""Tensor parallelism: logical-axis mapping, TP forward parity with the
+single-device model, and the driver's dp×tp dry run.
+
+The reference has no TP (SURVEY.md §2.3); the contract here is purely
+internal consistency — sharding must never change the math.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from machine_learning_apache_spark_tpu.models import Transformer, TransformerConfig
+from machine_learning_apache_spark_tpu.parallel import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    logical_to_mesh_spec,
+    make_mesh,
+    mesh_shardings,
+    shard_params,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(
+        src_vocab_size=64,
+        trg_vocab_size=80,
+        d_model=16,
+        ffn_hidden=32,
+        num_heads=4,
+        num_layers=2,
+        max_len=16,
+        dropout=0.0,
+    )
+    model = Transformer(cfg)
+    rng = jax.random.key(0)
+    src = jax.random.randint(rng, (4, 12), 1, 64, dtype=jnp.int32)
+    trg = jax.random.randint(rng, (4, 10), 1, 80, dtype=jnp.int32)
+    variables = model.init(rng, src, trg)
+    return model, variables, src, trg
+
+
+class TestLogicalToMeshSpec:
+    def test_known_names_map_to_model_axis(self, mesh):
+        assert logical_to_mesh_spec(P("embed", "heads"), mesh) == P(None, MODEL_AXIS)
+        assert logical_to_mesh_spec(P("mlp", "embed"), mesh) == P(MODEL_AXIS, None)
+
+    def test_unknown_name_replicates(self, mesh):
+        assert logical_to_mesh_spec(P("mystery"), mesh) == P(None)
+
+    def test_missing_mesh_axis_collapses(self):
+        dp_only = make_mesh({DATA_AXIS: 8})
+        assert logical_to_mesh_spec(P("embed", "heads"), dp_only) == P(None, None)
+
+    def test_tuple_entries(self, mesh):
+        assert logical_to_mesh_spec(P(("batch", "seq"), "heads"), mesh) == P(
+            (DATA_AXIS,), MODEL_AXIS
+        )
+
+
+class TestShardParams:
+    def test_kernels_sharded_biases_replicated(self, tiny, mesh):
+        model, variables, *_ = tiny
+        params = shard_params(variables["params"], mesh)
+        ffn_up = params["encoder"]["layer_0"]["ffn"]["up"]
+        assert ffn_up["kernel"].sharding.spec == P(None, MODEL_AXIS)
+        assert ffn_up["bias"].sharding.spec == P()
+
+    def test_shardings_tree_matches_params(self, tiny, mesh):
+        _, variables, *_ = tiny
+        sh = mesh_shardings(variables["params"], mesh)
+        import flax.linen as nn
+
+        assert jax.tree.structure(sh) == jax.tree.structure(
+            nn.unbox(variables["params"])
+        )
+
+    def test_tp_forward_matches_unsharded(self, tiny, mesh):
+        model, variables, src, trg = tiny
+        import flax.linen as nn
+
+        expected = model.apply(nn.unbox(variables), src, trg)
+        params = shard_params(variables["params"], mesh)
+        got = jax.jit(lambda p, s, t: model.apply({"params": p}, s, t))(
+            params, src, trg
+        )
+        assert jnp.allclose(expected, got, atol=1e-5)
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip(self):
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8)
+
+    def test_entry_traces(self):
+        import __graft_entry__ as g
+
+        fn, args = g.entry()
+        out = jax.eval_shape(fn, *args)
+        assert out.shape == (8, 128, 10240)
