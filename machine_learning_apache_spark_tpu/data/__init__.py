@@ -9,6 +9,17 @@ from machine_learning_apache_spark_tpu.data.loader import (
     DataLoader,
     random_split,
 )
+from machine_learning_apache_spark_tpu.data.text import (
+    PAD_ID,
+    SOS_ID,
+    EOS_ID,
+    UNK_ID,
+    TextPipeline,
+    Vocab,
+    classification_pipeline,
+    get_tokenizer,
+    translation_pipelines,
+)
 from machine_learning_apache_spark_tpu.data.datasets import (
     load_ag_news,
     load_fashion_mnist,
@@ -33,4 +44,13 @@ __all__ = [
     "synthetic_image_classification",
     "synthetic_text_classification",
     "synthetic_translation_pairs",
+    "PAD_ID",
+    "SOS_ID",
+    "EOS_ID",
+    "UNK_ID",
+    "TextPipeline",
+    "Vocab",
+    "classification_pipeline",
+    "get_tokenizer",
+    "translation_pipelines",
 ]

@@ -1,0 +1,180 @@
+"""Text preprocessing tests — golden outputs per SURVEY.md §4 (tokenizer /
+vocab / transform chain), mirroring what the reference builds inline at
+``pytorch_lstm.py:51-83`` and ``pytorch_machine_translator.py:20-98``."""
+
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu.data.datasets import (
+    synthetic_text_classification,
+    synthetic_translation_pairs,
+)
+from machine_learning_apache_spark_tpu.data.text import (
+    EOS_ID,
+    PAD_ID,
+    SOS_ID,
+    SPECIALS,
+    UNK_ID,
+    AddToken,
+    PadToLength,
+    Sequential,
+    TextPipeline,
+    ToArray,
+    Truncate,
+    Vocab,
+    VocabTransform,
+    basic_english,
+    classification_pipeline,
+    get_tokenizer,
+    translation_pipelines,
+    word_punct,
+)
+
+
+class TestTokenizers:
+    def test_basic_english_golden(self):
+        # torchtext basic_english behavior: lowercase, punct split, quotes gone
+        assert basic_english("You can now install TorchText using pip!") == [
+            "you", "can", "now", "install", "torchtext", "using", "pip", "!",
+        ]
+
+    def test_basic_english_punctuation(self):
+        assert basic_english('Hello, "World". Yes?') == [
+            "hello", ",", "world", ".", "yes", "?",
+        ]
+
+    def test_word_punct(self):
+        assert word_punct("Zwei Männer, gehen.") == [
+            "zwei", "männer", ",", "gehen", ".",
+        ]
+
+    def test_get_tokenizer_resolves(self):
+        assert get_tokenizer("basic_english") is basic_english
+        custom = lambda s: s.split()
+        assert get_tokenizer(custom) is custom
+        with pytest.raises(ValueError):
+            get_tokenizer("spacy-nonexistent")
+
+
+class TestVocab:
+    def test_specials_first(self):
+        v = Vocab.build_from_iterator([["b", "a", "b"]])
+        # specials occupy 0..3 in the reference's order (pytorch_lstm.py:58-67)
+        assert [v.lookup_token(i) for i in range(4)] == list(SPECIALS)
+        assert (PAD_ID, SOS_ID, EOS_ID, UNK_ID) == (0, 1, 2, 3)
+
+    def test_frequency_then_lexical_order(self):
+        v = Vocab.build_from_iterator([["b", "a", "b", "c", "a", "b"]])
+        # b(3) < a(2) < c(1); ties broken lexically
+        assert v.lookup_tokens([4, 5, 6]) == ["b", "a", "c"]
+
+    def test_default_index_is_own_unk(self):
+        v = Vocab.build_from_iterator([["x"]])
+        assert v["never-seen"] == UNK_ID  # quirk Q11 fixed
+
+    def test_min_freq_and_max_tokens(self):
+        v = Vocab.build_from_iterator([["a"] * 3 + ["b"] * 2 + ["c"]], min_freq=2)
+        assert "c" not in v and "a" in v and "b" in v
+        v2 = Vocab.build_from_iterator([["a"] * 3 + ["b"] * 2 + ["c"]], max_tokens=5)
+        assert len(v2) == 5 and "a" in v2 and "b" not in v2
+
+    def test_duplicate_tokens_deduped(self):
+        v = Vocab(["hi", "hi", "there"])
+        assert len(v) == 6  # 4 specials + 2 unique
+        assert v.lookup_tokens(v.lookup_indices(["hi", "there"])) == ["hi", "there"]
+
+    def test_roundtrip(self):
+        v = Vocab.build_from_iterator([["hello", "world"]])
+        ids = v.lookup_indices(["hello", "world"])
+        assert v.lookup_tokens(ids) == ["hello", "world"]
+
+
+class TestTransforms:
+    def test_chain_golden(self):
+        """The classification chain (pytorch_lstm.py:70-83): vocab → sos →
+        truncate → eos → pad-tensor."""
+        v = Vocab(["hi", "there"])
+        chain = Sequential(
+            VocabTransform(v),
+            AddToken(SOS_ID, begin=True),
+            Truncate(3),
+            AddToken(EOS_ID, begin=False),
+            ToArray(PAD_ID),
+        )
+        out = chain([["hi", "there"], ["hi", "there", "hi", "there"]])
+        hi, there = v["hi"], v["there"]
+        np.testing.assert_array_equal(
+            out,
+            [[SOS_ID, hi, there, EOS_ID],
+             [SOS_ID, hi, there, EOS_ID]],  # row 2 truncated to 3 incl sos
+        )
+        assert out.dtype == np.int32
+
+    def test_pad_to_length_fixed_shape(self):
+        p = PadToLength(6)
+        out = ToArray()(p([[5, 6], [7, 8, 9]]))
+        assert out.shape == (2, 6)
+        np.testing.assert_array_equal(out[0], [5, 6, 0, 0, 0, 0])
+
+    def test_pad_to_length_clips(self):
+        p = PadToLength(2)
+        assert p([[1, 2, 3, 4]]) == [[1, 2]]
+
+    def test_to_array_empty(self):
+        assert ToArray()([]).shape == (0, 0)
+
+
+class TestPipelines:
+    def test_classification_pipeline_on_synthetic(self):
+        texts, labels = synthetic_text_classification(n=64)
+        pipe = classification_pipeline(texts, max_seq_len=32)
+        ids = pipe(texts)
+        assert ids.ndim == 2 and ids.shape[0] == 64 and ids.shape[1] <= 34
+        assert (ids[:, 0] == SOS_ID).all()
+        # every row terminates with eos then pads
+        for row in ids:
+            nonpad = row[row != PAD_ID]
+            assert nonpad[-1] == EOS_ID
+
+    def test_translation_pipelines_fixed_200(self):
+        pairs = synthetic_translation_pairs(n=32)
+        src_pipe, trg_pipe = translation_pipelines(pairs, max_len=200)
+        src = src_pipe([s for s, _ in pairs])
+        trg = trg_pipe([t for _, t in pairs])
+        # the reference's hard fixed-length contract (quirk Q8 context):
+        # every sentence exactly 200 (pytorch_machine_translator.py:82,97)
+        assert src.shape == (32, 200) and trg.shape == (32, 200)
+
+    def test_translation_vocabs_separate(self):
+        pairs = synthetic_translation_pairs(n=16)
+        src_pipe, trg_pipe = translation_pipelines(pairs, max_len=64)
+        # target-language tokens (reversed+zn suffix, never valid source
+        # words) are OOV in the source vocab and real ids in their own
+        trg_word = pairs[0][1].split()[0]
+        assert trg_word not in src_pipe.vocab
+        assert src_pipe.vocab[trg_word] == UNK_ID
+        assert trg_pipe.vocab[trg_word] != UNK_ID
+
+    def test_fixed_len_too_small_rejected(self):
+        v = Vocab(["a"])
+        with pytest.raises(ValueError, match="eos would be clipped"):
+            TextPipeline(v, max_seq_len=128, fixed_len=128)
+
+    def test_translation_uses_full_capacity(self):
+        # a very long sentence fills all max_len slots: sos + content + eos
+        long_src = " ".join(["man"] * 300)
+        pairs = [(long_src, long_src)]
+        src_pipe, _ = translation_pipelines(pairs, max_len=50)
+        row = src_pipe([long_src])[0]
+        assert row.shape == (50,)
+        assert row[0] == SOS_ID and row[-1] == EOS_ID and (row != PAD_ID).all()
+
+    def test_pipeline_fit_unknown_maps_to_unk(self):
+        pipe = TextPipeline.fit(["a b c"], max_seq_len=8)
+        ids = pipe(["a z"])
+        assert UNK_ID in ids[0]
+
+    def test_deterministic(self):
+        texts, _ = synthetic_text_classification(n=16)
+        pipe = classification_pipeline(texts)
+        np.testing.assert_array_equal(pipe(texts), pipe(texts))
