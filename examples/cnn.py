@@ -1,0 +1,22 @@
+"""Sequential CNN — the ``pytorch_cnn.py`` entry point.
+
+TinyVGG on FashionMNIST (idx files under the given root, synthetic stand-in
+otherwise): SGD(0.01), 3 epochs, batch 32 (``pytorch_cnn.py:72,94-96,119``),
+train + eval with the reference's metric prints (``:148-151,172-176``).
+
+Usage: python examples/cnn.py [data_root]
+"""
+
+import sys
+
+from machine_learning_apache_spark_tpu.recipes import train_cnn
+
+out = train_cnn(
+    data_root=sys.argv[1] if len(sys.argv) > 1 else None,
+    log_every=100,
+)
+
+print(f"Training Time: {out['train_seconds']:.3f} sec")
+print(f"Total train loss (final epoch mean): {out['final_loss']:.5f}")
+print(f"Test loss: {out['test_loss']:.5f}")
+print(f"Test accuracy: {out['accuracy']:.2f}%")

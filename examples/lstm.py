@@ -1,0 +1,22 @@
+"""Sequential LSTM — the ``pytorch_lstm.py`` entry point.
+
+AG_NEWS text classification: basic_english tokenizer, vocab with
+pad/sos/eos/unk, truncate-128 chain, 2-layer LSTM(32) with last-timestep
+logits, Adam(1e-3), 3 epochs (``pytorch_lstm.py:28-43,124-188``).
+
+Usage: python examples/lstm.py [ag_news_root]
+"""
+
+import sys
+
+from machine_learning_apache_spark_tpu.recipes import train_lstm
+
+out = train_lstm(
+    data_root=sys.argv[1] if len(sys.argv) > 1 else None,
+    log_every=100,
+)
+
+print(f"Training Time: {out['train_seconds']:.3f} sec")
+print(f"vocab size: {out['vocab_size']}")
+print(f"Test loss: {out['test_loss']:.5f}")
+print(f"Test accuracy: {out['accuracy']:.2f}%")

@@ -1,0 +1,32 @@
+"""Sequential MLP — the ``pytorch_multilayer_perceptron.py`` entry point.
+
+Spark-style session bring-up with inline executor config
+(``pytorch_multilayer_perceptron.py:24-30``), libsvm ingestion when a path is
+given (``:51-52``), then the 4-5-4-3 sigmoid MLP trained with SGD(0.03) for
+100 epochs and evaluated — all on whatever single device JAX sees.
+
+Usage: python examples/multilayer_perceptron.py [path/to/libsvm.txt]
+"""
+
+import sys
+
+from machine_learning_apache_spark_tpu import Session
+from machine_learning_apache_spark_tpu.recipes import train_mlp
+
+spark = (
+    Session.builder.appName("MultilayerPerceptronClassifier")
+    .config("spark.executor.cores", "1")
+    .config("spark.executor.instances", "1")
+    .getOrCreate()
+)
+
+out = train_mlp(
+    data_path=sys.argv[1] if len(sys.argv) > 1 else None,
+    use_mesh=False,
+)
+
+print(f"Training Time: {out['train_seconds']:.3f} sec")
+print(f"Final train loss: {out['final_loss']:.5f}")
+print(f"Test loss: {out['test_loss']:.5f}")
+print(f"Test accuracy: {out['accuracy']:.2f}%")
+spark.stop()

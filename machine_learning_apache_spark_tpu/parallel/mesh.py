@@ -90,8 +90,23 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, batch, *, axis: str = DATA_AXIS):
-    """Place a host-local pytree of arrays onto the mesh, batch-dim sharded."""
+    """Place a host-local pytree of arrays onto the mesh, batch-dim sharded.
+
+    Single-process: a plain sharded ``device_put``. Multi-process (the mesh
+    spans hosts): each process holds only its *local slice* of the global
+    batch (the ``DistributedSampler`` shard, ``distributed_cnn.py:112-119``)
+    and the global array is assembled per-shard via
+    ``jax.make_array_from_process_local_data`` — the L3 mapping in SURVEY.md
+    (§1): per-process slicing + sharded device arrays.
+    """
     sharding = batch_sharding(mesh, axis=axis)
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            batch,
+        )
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 

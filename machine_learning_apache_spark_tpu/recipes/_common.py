@@ -1,0 +1,151 @@
+"""Shared recipe plumbing — the boilerplate every reference script repeats.
+
+A recipe is the framework's unit of "one reference entry-point script": data
+resolution (real files if present, synthetic stand-in otherwise — this image
+has no egress, so the reference's ``download=True`` cannot be mirrored),
+mesh/world bring-up, the fit/evaluate calls, and a **picklable** result dict
+(the launcher returns rank 0's result across a process boundary —
+``distributor.run`` contract, ``distributed_cnn.py:231``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from machine_learning_apache_spark_tpu.data import (
+    ArrayDataset,
+    DataLoader,
+    DistributedSampler,
+)
+from machine_learning_apache_spark_tpu.parallel.mesh import (
+    DATA_AXIS,
+    data_parallel_mesh,
+)
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def resolve_mesh(use_mesh: bool = True):
+    """Data-parallel mesh over every addressable device, or None when a mesh
+    buys nothing (single device, single process)."""
+    if jax.process_count() > 1 and not use_mesh:
+        # Without a mesh there is no gradient sync: each rank would train an
+        # independent replica on its shard and rank 0's metrics would
+        # masquerade as a full-data run.
+        raise ValueError(
+            "use_mesh=False under a multi-process gang would train "
+            "independent unsynchronized replicas; run single-process or "
+            "keep use_mesh=True"
+        )
+    if use_mesh and (jax.device_count() > 1 or jax.process_count() > 1):
+        return data_parallel_mesh()
+    return None
+
+
+def with_overrides(recipe, overrides: dict):
+    """``dataclasses.replace`` with the no-override fast path — the shared
+    ``train_x(recipe, **overrides)`` config idiom."""
+    import dataclasses
+
+    return dataclasses.replace(recipe, **overrides) if overrides else recipe
+
+
+def make_loaders(
+    train_ds: ArrayDataset,
+    test_ds: ArrayDataset | None,
+    *,
+    batch_size: int,
+    mesh,
+    seed: int = 0,
+    collate: Callable[[tuple], Any] | None = None,
+) -> tuple[DataLoader, DataLoader | None]:
+    """Reference loader semantics, mesh-aware.
+
+    The reference keeps ``batch_size`` **per replica** and shards the
+    *dataset* across ranks (``DistributedSampler`` + per-rank loaders,
+    ``distributed_cnn.py:112-124``); the global batch is therefore
+    ``batch_size × world``. Here:
+
+    - multi-process: each process samples its rank's shard
+      (``DistributedSampler`` with correct Q3 semantics) at
+      ``batch_size × local_replicas`` so the assembled global batch is
+      ``batch_size × data_axis_size``;
+    - single-process multi-device: one loader at ``batch_size × data_axis``
+      and the mesh splits it — same per-replica batch, no sampler needed.
+
+    ``drop_last=True`` everywhere: one static shape, one XLA program.
+    """
+    world = jax.process_count()
+    data_size = mesh.shape[DATA_AXIS] if mesh is not None else 1
+    local_scale = data_size // world if mesh is not None else 1
+
+    def _clamped(n_rows: int, want: int, split: str) -> int:
+        """Largest mesh-divisible batch ≤ want that ``n_rows`` can fill at
+        least once (drop_last keeps one static shape). Loud when the split
+        cannot fill even one shard per device."""
+        if mesh is None:
+            return min(want, max(n_rows, 1))
+        largest = (n_rows // local_scale) * local_scale
+        if largest == 0:
+            raise ValueError(
+                f"{split} split ({n_rows} rows on this process) cannot fill "
+                f"one row per local device ({local_scale}); provide more "
+                "data or a smaller mesh"
+            )
+        if want > largest:
+            log.warning(
+                "%s batch %d exceeds the %d-row split; clamping to %d",
+                split, want, n_rows, largest,
+            )
+        return min(want, largest)
+
+    sampler = None
+    if world > 1:
+        sampler = DistributedSampler(len(train_ds), seed=seed)
+    n_train = len(sampler) if sampler is not None else len(train_ds)
+    train_loader = DataLoader(
+        train_ds,
+        _clamped(n_train, batch_size * local_scale, "train"),
+        shuffle=sampler is None,
+        sampler=sampler,
+        drop_last=True,
+        seed=seed,
+        collate=collate,
+    )
+    test_loader = None
+    if test_ds is not None:
+        test_sampler = (
+            DistributedSampler(len(test_ds), shuffle=False, seed=seed)
+            if world > 1
+            else None
+        )
+        n_test = len(test_sampler) if test_sampler is not None else len(test_ds)
+        test_loader = DataLoader(
+            test_ds,
+            _clamped(n_test, batch_size * local_scale, "test"),
+            sampler=test_sampler,
+            drop_last=True,
+            seed=seed,
+            collate=collate,
+        )
+    return train_loader, test_loader
+
+
+def summarize(fit_result, eval_metrics: dict | None, **extra) -> dict:
+    """The printable/picklable end-of-run contract — the reference's metric
+    vocabulary (SURVEY.md §5: train wall-time, losses, accuracy %)."""
+    out = {
+        "train_seconds": fit_result.train_seconds,
+        "final_loss": fit_result.final_loss,
+        "epochs": len(fit_result.history),
+        "history": fit_result.history,
+        "world_processes": jax.process_count(),
+        "devices": jax.device_count(),
+    }
+    if eval_metrics:
+        out.update(eval_metrics)
+    out.update(extra)
+    return out

@@ -1,0 +1,121 @@
+"""LSTM recipe — the AG_NEWS text classification workload (C9).
+
+Sequential form: ``pytorch_lstm.py:131-188`` — basic_english tokenizer, vocab
+with pad/sos/eos/unk, truncate-128 transform chain, Embedding(32) → 2-layer
+LSTM(32) → Linear head, loss on the last timestep's logits
+(``pytorch_lstm.py:160``), Adam(lr=1e-3), 3 epochs, batch 32. Distributed
+form: ``distributed_lstm.py:156-215`` adds gloo+DDP with a (never actually
+used — quirk Q5) sharded datapipe. One recipe here, with the tokenization
+hoisted *out* of the training loop (the reference tokenizes per batch inside
+it, ``pytorch_lstm.py:148`` — host-bound on a TPU, SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+from machine_learning_apache_spark_tpu.data import ArrayDataset
+from machine_learning_apache_spark_tpu.data.datasets import (
+    load_ag_news,
+    synthetic_text_classification,
+)
+from machine_learning_apache_spark_tpu.data.text import classification_pipeline
+from machine_learning_apache_spark_tpu.models import LSTMClassifier
+from machine_learning_apache_spark_tpu.train.loop import (
+    classification_loss,
+    evaluate,
+    fit,
+)
+from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
+from machine_learning_apache_spark_tpu.recipes._common import (
+    make_loaders,
+    with_overrides,
+    resolve_mesh,
+    summarize,
+)
+
+
+@dataclass
+class LSTMRecipe:
+    """Reference hypers: ``pytorch_lstm.py:28-43,124-128`` (embed 32, hidden
+    32, 2 layers, dropout 0.5, max_seq_len 128, Adam 1e-3, 3 epochs)."""
+
+    embed_dim: int = 32
+    hidden_size: int = 32
+    num_layers: int = 2
+    num_classes: int = 4
+    dropout: float = 0.5
+    max_seq_len: int = 128
+    epochs: int = 3
+    learning_rate: float = 1e-3
+    batch_size: int = 32
+    seed: int = 0
+    data_root: str | None = None  # AG_NEWS csv root; None → synthetic
+    synthetic_n: int = 2048
+    use_mesh: bool = True
+    log_every: int = 0
+
+
+def train_lstm(recipe: LSTMRecipe | None = None, **overrides) -> dict:
+    r = with_overrides(recipe or LSTMRecipe(), overrides)
+
+    if r.data_root:
+        train_texts, train_labels = load_ag_news(r.data_root, train=True)
+        test_texts, test_labels = load_ag_news(r.data_root, train=False)
+    else:
+        train_texts, train_labels = synthetic_text_classification(
+            r.synthetic_n, num_classes=r.num_classes, seed=r.seed
+        )
+        test_texts, test_labels = synthetic_text_classification(
+            max(r.synthetic_n // 4, 128), num_classes=r.num_classes,
+            seed=r.seed + 1,
+        )
+
+    # Preprocessing hoisted out of the hot loop: tokenize+transform the whole
+    # corpus once, pad to one fixed width (one XLA program for every batch).
+    pipe = classification_pipeline(
+        train_texts, max_seq_len=r.max_seq_len, fixed_len=r.max_seq_len + 1
+    )
+    train_ds = ArrayDataset(pipe(train_texts), train_labels)
+    test_ds = ArrayDataset(pipe(test_texts), test_labels)
+
+    mesh = resolve_mesh(r.use_mesh)
+    train_loader, test_loader = make_loaders(
+        train_ds, test_ds, batch_size=r.batch_size, mesh=mesh, seed=r.seed
+    )
+
+    model = LSTMClassifier(
+        vocab_size=len(pipe.vocab),
+        embed_dim=r.embed_dim,
+        hidden_size=r.hidden_size,
+        num_layers=r.num_layers,
+        num_classes=r.num_classes,
+        dropout=r.dropout,
+    )
+    params = model.init(jax.random.key(r.seed), train_ds[:1][0])["params"]
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=params,
+        tx=make_optimizer("adam", r.learning_rate),
+    )
+
+    # Loss on the final timestep's logits — pred[:, -1, :]
+    # (``pytorch_lstm.py:160``).
+    result = fit(
+        state,
+        classification_loss(model.apply, last_timestep=True),
+        train_loader,
+        epochs=r.epochs,
+        rng=jax.random.key(r.seed),
+        mesh=mesh,
+        log_every=r.log_every,
+    )
+    metrics = evaluate(
+        result.state,
+        classification_loss(model.apply, last_timestep=True, train=False),
+        test_loader,
+        mesh=mesh,
+    )
+    return summarize(result, metrics, vocab_size=len(pipe.vocab))

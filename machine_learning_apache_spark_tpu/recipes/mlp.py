@@ -1,0 +1,102 @@
+"""MLP recipe — the reference's MLP entry points as one function (C3 + C4).
+
+Sequential form: ``pytorch_multilayer_perceptron.py:83-146`` — libsvm 4-class
+data via Spark, 4-5-4-3 sigmoid MLP, CrossEntropy, SGD(lr=0.03), 100 epochs,
+batch 30, 60/40 split, then an eval pass printing accuracy. Distributed form:
+``distributed_multilayer_perceptron.py:96-181`` — the same wrapped in
+gloo+DDP and launched by TorchDistributor. Here both are *the same recipe*:
+run it under one process and the mesh is trivial; run it under the
+``Distributor`` (or on a pod) and the identical jitted step data-parallels
+over every chip — the DDP layer is three lines of compiled collective
+(SURVEY.md §7), not a separate script.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+from machine_learning_apache_spark_tpu.data import ArrayDataset, read_libsvm
+from machine_learning_apache_spark_tpu.data.datasets import synthetic_multiclass
+from machine_learning_apache_spark_tpu.models import MLP
+from machine_learning_apache_spark_tpu.train.loop import (
+    classification_loss,
+    evaluate,
+    fit,
+)
+from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
+from machine_learning_apache_spark_tpu.recipes._common import (
+    make_loaders,
+    with_overrides,
+    resolve_mesh,
+    summarize,
+)
+
+
+@dataclass
+class MLPRecipe:
+    """Reference hypers (``pytorch_multilayer_perceptron.py:93-96``; split
+    seed 1234 from ``mllib_multilayer_perceptron_classifier.py:27``)."""
+
+    layers: tuple[int, ...] = (4, 5, 4, 3)
+    epochs: int = 100
+    learning_rate: float = 0.03
+    batch_size: int = 30
+    train_fraction: float = 0.6
+    seed: int = 1234
+    data_path: str | None = None  # libsvm file; None → synthetic blobs
+    synthetic_n: int = 600
+    use_mesh: bool = True
+    log_every: int = 0  # the reference prints per-batch; default quiet
+
+
+def train_mlp(recipe: MLPRecipe | None = None, **overrides) -> dict:
+    """Run the MLP workload end to end; returns the metric dict."""
+    r = with_overrides(recipe or MLPRecipe(), overrides)
+
+    frame = (
+        read_libsvm(r.data_path)
+        if r.data_path
+        else synthetic_multiclass(
+            r.synthetic_n, num_features=r.layers[0], num_classes=r.layers[-1],
+            seed=r.seed,
+        )
+    )
+    train_frame, test_frame = frame.random_split(
+        [r.train_fraction, 1 - r.train_fraction], seed=r.seed
+    )
+    train_ds = ArrayDataset(*train_frame.arrays())
+    test_ds = ArrayDataset(*test_frame.arrays())
+
+    mesh = resolve_mesh(r.use_mesh)
+    train_loader, test_loader = make_loaders(
+        train_ds, test_ds, batch_size=r.batch_size, mesh=mesh, seed=r.seed
+    )
+
+    model = MLP(layers=r.layers)
+    params = model.init(
+        jax.random.key(r.seed), train_ds[:1][0]
+    )["params"]
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=params,
+        tx=make_optimizer("sgd", r.learning_rate),
+    )
+
+    result = fit(
+        state,
+        classification_loss(model.apply),
+        train_loader,
+        epochs=r.epochs,
+        rng=jax.random.key(r.seed),
+        mesh=mesh,
+        log_every=r.log_every,
+    )
+    metrics = evaluate(
+        result.state,
+        classification_loss(model.apply, train=False),
+        test_loader,
+        mesh=mesh,
+    )
+    return summarize(result, metrics)

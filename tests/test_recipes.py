@@ -1,0 +1,82 @@
+"""Recipe tests — the reference's implicit criterion made explicit
+(SURVEY.md §4): loss decreases over training and accuracy is sane, per
+workload, on the 8-virtual-device CPU mesh."""
+
+import pytest
+
+from machine_learning_apache_spark_tpu.recipes import (
+    train_cnn,
+    train_lstm,
+    train_mlp,
+    train_translator,
+)
+
+
+class TestMLPRecipe:
+    def test_learns_and_reports(self):
+        # sigmoid MLP + SGD(0.03) learns slowly (the reference runs 100
+        # epochs, pytorch_multilayer_perceptron.py:100); assert clear
+        # progress over chance (33%), not convergence
+        out = train_mlp(epochs=250, synthetic_n=480, batch_size=8)
+        assert out["devices"] == 8
+        assert out["accuracy"] > 55.0  # percent
+        assert out["train_seconds"] > 0
+        assert out["history"][-1]["loss"] < out["history"][0]["loss"]
+
+    def test_no_mesh_path(self):
+        out = train_mlp(epochs=5, synthetic_n=240, use_mesh=False)
+        assert out["epochs"] == 5
+
+
+class TestCNNRecipe:
+    def test_loss_decreases(self):
+        out = train_cnn(epochs=2, synthetic_n=512, batch_size=16)
+        assert out["history"][-1]["loss"] < out["history"][0]["loss"]
+        assert "test_loss" in out and "accuracy" in out
+
+
+class TestLSTMRecipe:
+    def test_loss_decreases(self):
+        out = train_lstm(
+            epochs=2, synthetic_n=512, batch_size=16, max_seq_len=24
+        )
+        assert out["history"][-1]["loss"] < out["history"][0]["loss"]
+        assert out["vocab_size"] > 4
+        # topical synthetic text is separable; after 2 epochs the classifier
+        # should beat 4-class chance
+        assert out["accuracy"] > 30.0  # percent
+
+
+class TestTranslationRecipe:
+    def test_loss_decreases(self):
+        out = train_translator(
+            epochs=1,
+            synthetic_n=256,
+            batch_size=8,
+            max_len=24,
+            d_model=32,
+            ffn_hidden=64,
+            num_heads=4,
+            log_every=0,
+        )
+        assert out["history"][-1]["loss"] < 7.0  # below ~ln(vocab) start
+        assert out["src_vocab"] > 4 and out["trg_vocab"] > 4
+        assert "test_loss" in out
+
+
+@pytest.mark.slow
+class TestDistributedRecipe:
+    def test_mlp_under_distributor(self):
+        """The TorchDistributor contract end to end: 2-process CPU gang runs
+        the same recipe fn by reference, rank 0's metric dict returns
+        (``distributed_multilayer_perceptron.py:177-181`` equivalent)."""
+        from machine_learning_apache_spark_tpu.launcher import Distributor
+
+        out = Distributor(num_processes=2, platform="cpu", timeout=300).run(
+            "machine_learning_apache_spark_tpu.recipes.mlp:train_mlp",
+            epochs=3,
+            synthetic_n=240,
+            log_every=0,
+        )
+        assert out["world_processes"] == 2
+        assert out["epochs"] == 3
