@@ -7,7 +7,10 @@ train + eval with the reference's metric prints (``:148-151,172-176``).
 Usage: python examples/cnn.py [data_root]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from machine_learning_apache_spark_tpu.recipes import train_cnn
 

@@ -11,7 +11,10 @@ cluster scheduler instead of local_mode.
 Usage: python examples/distributed_cnn.py [n_processes] [data_root]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from machine_learning_apache_spark_tpu import Session
 from machine_learning_apache_spark_tpu.launcher import Distributor

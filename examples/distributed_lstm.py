@@ -7,7 +7,10 @@ sharding the reference builds but never uses (quirk Q5) is here a real
 Usage: python examples/distributed_lstm.py [n_processes] [ag_news_root]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from machine_learning_apache_spark_tpu import Session
 from machine_learning_apache_spark_tpu.launcher import Distributor

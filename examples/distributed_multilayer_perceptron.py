@@ -9,7 +9,10 @@ rendezvous, gradient psum over the mesh, rank 0's metrics returned.
 Usage: python examples/distributed_multilayer_perceptron.py [n_processes]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from machine_learning_apache_spark_tpu import Session
 from machine_learning_apache_spark_tpu.launcher import Distributor

@@ -7,7 +7,10 @@ logits, Adam(1e-3), 3 epochs (``pytorch_lstm.py:28-43,124-188``).
 Usage: python examples/lstm.py [ag_news_root]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from machine_learning_apache_spark_tpu.recipes import train_lstm
 

@@ -9,7 +9,10 @@ on the MXU; data parallelism engages automatically on a multi-chip slice.
 Usage: python examples/machine_translator.py [multi30k_root]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from machine_learning_apache_spark_tpu.recipes import train_translator
 

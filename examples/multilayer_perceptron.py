@@ -8,7 +8,10 @@ given (``:51-52``), then the 4-5-4-3 sigmoid MLP trained with SGD(0.03) for
 Usage: python examples/multilayer_perceptron.py [path/to/libsvm.txt]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from machine_learning_apache_spark_tpu import Session
 from machine_learning_apache_spark_tpu.recipes import train_mlp

@@ -1,0 +1,22 @@
+"""mllib — the Spark-MLlib-parity baseline engine (reference C1).
+
+The reference's ``mllib_multilayer_perceptron_classifier.py`` trains a
+JVM-native MLP with breeze L-BFGS and evaluates accuracy via
+``MulticlassClassificationEvaluator``. This module provides the same
+estimator/transformer/evaluator API over the framework's own compute path —
+the "other engine" axis of the reference's capability matrix (SURVEY.md §0).
+"""
+
+from machine_learning_apache_spark_tpu.mllib.classifier import (
+    MultilayerPerceptronClassifier,
+    MultilayerPerceptronClassificationModel,
+)
+from machine_learning_apache_spark_tpu.mllib.evaluation import (
+    MulticlassClassificationEvaluator,
+)
+
+__all__ = [
+    "MultilayerPerceptronClassifier",
+    "MultilayerPerceptronClassificationModel",
+    "MulticlassClassificationEvaluator",
+]
