@@ -76,7 +76,10 @@ class MultilayerPerceptronClassifier:
     ``blockSize`` is accepted for parity; it is a JVM data-stacking
     performance knob with no XLA meaning (full-batch compute is already one
     fused program). ``stepSize`` applies only to ``solver='gd'`` — MLlib's
-    own documented semantics (l-bfgs uses its linesearch instead).
+    own documented semantics (l-bfgs uses its linesearch instead). ``tol``
+    is the convergence test on per-iteration loss improvement; once met, the
+    remaining scan iterations freeze the carry (static trip count, compiled
+    once).
     """
 
     layers: Sequence[int] = (4, 5, 4, 3)
@@ -111,46 +114,57 @@ class MultilayerPerceptronClassifier:
             # MLlib's alternative solver ('gd' stepSize semantics).
             opt = optax.sgd(self.stepSize)
 
-            def step(carry, _):
-                p, s = carry
+            def compute_update(p, s):
                 value, grad = jax.value_and_grad(loss_fn)(p)
                 updates, s = opt.update(grad, s, p)
-                return (optax.apply_updates(p, updates), s), value
-
-            @jax.jit
-            def run(p):
-                (p, _), hist = jax.lax.scan(
-                    step, (p, opt.init(p)), length=self.maxIter
-                )
-                return p, hist
+                return value, updates, s
 
         else:
             opt = optax.lbfgs(memory_size=10)
             value_and_grad = optax.value_and_grad_from_state(loss_fn)
 
-            def step(carry, _):
-                p, s = carry
+            def compute_update(p, s):
                 value, grad = value_and_grad(p, state=s)
                 updates, s = opt.update(
                     grad, s, p, value=value, grad=grad, value_fn=loss_fn
                 )
-                return (optax.apply_updates(p, updates), s), value
+                return value, updates, s
 
-            @jax.jit
-            def run(p):
-                # The whole optimizer — maxIter × (full-batch fwd+bwd +
-                # two-loop recursion + zoom linesearch) — is ONE XLA program.
-                (p, _), hist = jax.lax.scan(
-                    step, (p, opt.init(p)), length=self.maxIter
-                )
-                return p, hist
+        def step(carry, _):
+            p, s, prev, done = carry
+            value, updates, s_new = compute_update(p, s)
+            # MLlib's `tol` convergence test: stop when the loss improvement
+            # falls below tol. Inside a fixed-length scan "stop" = freeze the
+            # carry (the remaining iterations are no-ops the compiler can
+            # still schedule; trip count stays static).
+            done_now = done | (jnp.abs(prev - value) < self.tol)
+            keep = lambda old, new: jax.tree.map(
+                lambda a, b: jnp.where(done, a, b), old, new
+            )
+            p = keep(p, optax.apply_updates(p, updates))
+            s = keep(s, s_new)
+            return (p, s, value, done_now), (value, done_now)
 
-        params, history = run(params)
+        @jax.jit
+        def run(p):
+            # The whole optimizer — maxIter × (full-batch fwd+bwd + update
+            # rule, incl. l-bfgs two-loop recursion and zoom linesearch) —
+            # is ONE XLA program.
+            carry = (p, opt.init(p), jnp.inf, jnp.asarray(False))
+            (p, _, _, _), (hist, dones) = jax.lax.scan(
+                step, carry, length=self.maxIter
+            )
+            return p, hist, dones
+
+        params, history, dones = run(params)
         history = np.asarray(history)
         if history.size:
+            iters = int((~np.asarray(dones)).sum())
             log.info(
-                "%s converged: loss %.6f -> %.6f in %d iterations",
-                self.solver, history[0], history[-1], self.maxIter,
+                "%s: loss %.6f -> %.6f, %s after %d/%d iterations",
+                self.solver, history[0], history[-1],
+                "converged" if iters < self.maxIter else "stopped",
+                min(iters + 1, self.maxIter), self.maxIter,
             )
         return MultilayerPerceptronClassificationModel(
             mlp=mlp, params=jax.device_get(params), loss_history=history

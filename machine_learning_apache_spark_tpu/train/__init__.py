@@ -12,6 +12,11 @@ from machine_learning_apache_spark_tpu.train.metrics import (
     logits_accuracy,
 )
 from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
+from machine_learning_apache_spark_tpu.train.checkpoint import (
+    CheckpointManager,
+    load_params,
+    save_params,
+)
 from machine_learning_apache_spark_tpu.train.loop import (
     FitResult,
     classification_loss,
@@ -31,6 +36,9 @@ __all__ = [
     "logits_accuracy",
     "TrainState",
     "make_optimizer",
+    "CheckpointManager",
+    "load_params",
+    "save_params",
     "FitResult",
     "classification_loss",
     "evaluate",

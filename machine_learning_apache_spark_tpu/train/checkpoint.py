@@ -1,0 +1,114 @@
+"""Checkpoint / resume — param + optimizer-state persistence.
+
+The reference has **no** checkpointing (SURVEY.md §5: no ``torch.save`` /
+``state_dict`` anywhere; models are trained and discarded, and
+``distributor.run`` returns None — quirk Q7). Its only "persistence" is
+train-then-evaluate in-process. The framework provides the real thing:
+step-numbered checkpoints via orbax (sharding-aware — params keep their
+``NamedSharding`` layout on restore, so a TP/DP-sharded run resumes without
+a resharding pass), latest-step resume, and bounded retention.
+
+Only the pytree half of ``TrainState`` (step / params / opt_state) is
+persisted; ``apply_fn``/``tx`` are code, recreated by the caller — which is
+why ``restore`` takes a template state built by ``TrainState.create``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from machine_learning_apache_spark_tpu.train.state import TrainState
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints under one directory.
+
+    >>> ckpt = CheckpointManager(dir, max_to_keep=3)
+    >>> ckpt.save(state)                       # step taken from state.step
+    >>> state, step = ckpt.restore(template)   # latest by default
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    # -- write ---------------------------------------------------------------
+    def save(self, state: TrainState, *, step: int | None = None, wait: bool = True) -> int:
+        step = int(state.step if step is None else step)
+        payload = {
+            "step": jax.device_get(state.step),
+            "params": state.params,
+            "opt_state": state.opt_state,
+        }
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        if wait:
+            self._mgr.wait_until_finished()
+        log.info("checkpoint step %d -> %s", step, self.directory)
+        return step
+
+    # -- read ----------------------------------------------------------------
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def restore(
+        self, template: TrainState, *, step: int | None = None
+    ) -> tuple[TrainState, int]:
+        """Restore into the shapes/dtypes/shardings of ``template`` (a state
+        built by ``TrainState.create`` with the same model/optimizer)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        target = {
+            "step": jax.device_get(template.step),
+            "params": template.params,
+            "opt_state": template.opt_state,
+        }
+        payload = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(target)
+        )
+        state = template.replace(
+            step=payload["step"],
+            params=payload["params"],
+            opt_state=payload["opt_state"],
+        )
+        log.info("restored checkpoint step %d from %s", step, self.directory)
+        return state, step
+
+    def wait(self) -> None:
+        """Block until in-flight async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def save_params(path: str, params) -> None:
+    """One-shot param-only save (the minimal eval-after-train handoff)."""
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), params)
+
+
+def load_params(path: str, template=None):
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path), template)

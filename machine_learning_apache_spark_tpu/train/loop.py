@@ -74,6 +74,8 @@ def fit(
     mesh=None,
     log_every: int = 100,
     emit: Callable[[str], None] | None = None,
+    checkpointer=None,
+    checkpoint_every: int = 1,
 ) -> FitResult:
     """The canonical loop (``pytorch_cnn.py:125-146`` shape): epochs × batches,
     per-``log_every``-batch loss/time prints
@@ -83,6 +85,10 @@ def fit(
     ``train_loader`` yields batch pytrees; if it has ``set_epoch``, it is
     called per epoch (the ``sampler.set_epoch`` contract,
     ``distributed_cnn.py:168``, with correct Q3 semantics).
+
+    ``checkpointer`` (a ``train.checkpoint.CheckpointManager``) saves the
+    state every ``checkpoint_every`` epochs — persistence the reference
+    lacks entirely (SURVEY.md §5 checkpoint/resume).
     """
     emit = emit or log.info
     rng = rng if rng is not None else jax.random.key(0)
@@ -128,11 +134,19 @@ def fit(
         history.append(computed)
         if log_every:
             emit(f"epoch {epoch} done | {epoch_metrics.log_line()}")
+        if checkpointer is not None and (
+            (epoch + 1) % max(checkpoint_every, 1) == 0 or epoch == epochs - 1
+        ):
+            # Async: orbax snapshots to host and writes in the background, so
+            # checkpoint I/O never stalls device dispatch mid-training.
+            checkpointer.save(state, wait=False)
     # Block on the final state so the reported wall-time includes device work
     # (the reference's time.time() pairs measure eager CPU execution; under
     # async dispatch the analogue requires a sync point).
     jax.block_until_ready(state.params)
     seconds = total_timer.stop()
+    if checkpointer is not None:
+        checkpointer.wait()  # durability barrier, outside the timed span
     emit(f"Training Time: {seconds:.3f} sec")
     return FitResult(state=state, train_seconds=seconds, history=history)
 

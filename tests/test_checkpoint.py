@@ -1,0 +1,133 @@
+"""Checkpoint/resume tests: round-trip (incl. sharded params), latest-step
+resume, retention, and the resumed-training-continues property."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu.models import MLP
+from machine_learning_apache_spark_tpu.parallel import make_mesh
+from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS, replicate
+from machine_learning_apache_spark_tpu.train.checkpoint import (
+    CheckpointManager,
+    load_params,
+    save_params,
+)
+from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
+
+
+def make_state(seed=0):
+    model = MLP(layers=(4, 8, 3))
+    params = model.init(jax.random.key(seed), jnp.ones((1, 4)))["params"]
+    return TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_optimizer("adam", 1e-3)
+    )
+
+
+def assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+
+
+class TestCheckpointManager:
+    def test_roundtrip(self, tmp_path):
+        state = make_state()
+        with CheckpointManager(str(tmp_path / "ckpt")) as ckpt:
+            ckpt.save(state, step=5)
+            restored, step = ckpt.restore(make_state(seed=1))
+        assert step == 5
+        assert int(restored.step) == 0  # template step overwritten by saved 0
+        assert_trees_equal(restored.params, state.params)
+        assert_trees_equal(restored.opt_state, state.opt_state)
+
+    def test_latest_resume_and_retention(self, tmp_path):
+        with CheckpointManager(str(tmp_path / "c"), max_to_keep=2) as ckpt:
+            for s in (1, 2, 3):
+                ckpt.save(make_state(seed=s), step=s)
+            assert ckpt.latest_step() == 3
+            assert ckpt.all_steps() == [2, 3]  # max_to_keep pruned step 1
+            _, step = ckpt.restore(make_state())
+            assert step == 3
+
+    def test_restore_empty_raises(self, tmp_path):
+        with CheckpointManager(str(tmp_path / "empty")) as ckpt:
+            with pytest.raises(FileNotFoundError):
+                ckpt.restore(make_state())
+
+    def test_training_continues_after_restore(self, tmp_path):
+        """Save mid-training, restore, take one more step: identical to the
+        uninterrupted run (the resume contract)."""
+        def loss_fn(params, x, y, apply_fn):
+            return jnp.mean(
+                (apply_fn({"params": params}, x) - y) ** 2
+            )
+
+        x = jnp.ones((8, 4))
+        y = jnp.ones((8, 3))
+        state = make_state()
+        grad_fn = jax.grad(loss_fn)
+
+        def step_once(s):
+            return s.apply_gradients(
+                grad_fn(s.params, x, y, s.apply_fn)
+            )
+
+        mid = step_once(step_once(state))
+        with CheckpointManager(str(tmp_path / "r")) as ckpt:
+            ckpt.save(mid)
+            restored, _ = ckpt.restore(make_state(seed=9))
+        final_direct = step_once(mid)
+        final_resumed = step_once(restored)
+        assert_trees_equal(final_direct.params, final_resumed.params)
+        assert int(final_resumed.step) == 3
+
+    def test_sharded_params_keep_sharding(self, tmp_path):
+        """Params saved from a mesh restore with the template's sharding —
+        the sharded-resume property (orbax is sharding-aware)."""
+        mesh = make_mesh({DATA_AXIS: 8})
+        state = make_state()
+        sharded = replicate(mesh, state)
+        with CheckpointManager(str(tmp_path / "s")) as ckpt:
+            ckpt.save(sharded, step=1)
+            template = replicate(mesh, make_state(seed=2))
+            restored, _ = ckpt.restore(template)
+        leaf = jax.tree.leaves(restored.params)[0]
+        assert leaf.sharding.mesh.shape[DATA_AXIS] == 8
+        assert_trees_equal(restored.params, state.params)
+
+
+class TestFitIntegration:
+    def test_fit_saves_per_epoch(self, tmp_path):
+        from machine_learning_apache_spark_tpu.data import ArrayDataset, DataLoader
+        from machine_learning_apache_spark_tpu.train.loop import (
+            classification_loss,
+            fit,
+        )
+
+        state = make_state()
+        ds = ArrayDataset(
+            np.random.default_rng(0).normal(size=(32, 4)).astype(np.float32),
+            np.zeros(32, dtype=np.int64),
+        )
+        loader = DataLoader(ds, 8)
+        with CheckpointManager(str(tmp_path / "fit")) as ckpt:
+            fit(
+                state,
+                classification_loss(state.apply_fn),
+                loader,
+                epochs=3,
+                log_every=0,
+                checkpointer=ckpt,
+                checkpoint_every=2,
+            )
+            # saves after epoch 2 (index 1) and the final epoch
+            assert ckpt.all_steps() == [8, 12]
+
+
+class TestParamsOnly:
+    def test_save_load(self, tmp_path):
+        state = make_state()
+        save_params(str(tmp_path / "p"), state.params)
+        loaded = load_params(str(tmp_path / "p"), state.params)
+        assert_trees_equal(loaded, state.params)

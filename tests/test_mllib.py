@@ -55,6 +55,21 @@ class TestClassifier:
         with pytest.raises(ValueError):
             MultilayerPerceptronClassifier(solver="newton").fit(data)
 
+    def test_tol_freezes_after_convergence(self):
+        """Once |Δloss| < tol the carry freezes: the loss history goes flat
+        instead of continuing to change (MLlib's tol semantics)."""
+        data = synthetic_multiclass(120, seed=0)
+        model = MultilayerPerceptronClassifier(maxIter=60, tol=1e-2).fit(data)
+        hist = model.loss_history
+        deltas = np.abs(np.diff(hist))
+        assert (deltas < 1e-2).any()
+        first_conv = np.argmax(deltas < 1e-2)
+        # the triggering iteration still applies its in-flight update; the
+        # freeze lands on the following one, so deltas go exactly flat two
+        # entries after the first sub-tol improvement
+        assert (deltas[first_conv + 2 :] == 0).all()
+        assert len(deltas[first_conv + 2 :]) > 0  # actually froze early
+
     def test_gd_solver_runs(self):
         data = synthetic_multiclass(120, seed=0)
         model = MultilayerPerceptronClassifier(
