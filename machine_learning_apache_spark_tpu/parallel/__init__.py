@@ -25,6 +25,9 @@ from machine_learning_apache_spark_tpu.parallel.data_parallel import (
     pad_batch_to_multiple,
     params_fingerprint,
 )
+from machine_learning_apache_spark_tpu.parallel.ring_attention import (
+    ring_attention,
+)
 from machine_learning_apache_spark_tpu.parallel.tensor_parallel import (
     DEFAULT_RULES,
     logical_to_mesh_spec,
@@ -50,6 +53,7 @@ __all__ = [
     "make_data_parallel_step",
     "pad_batch_to_multiple",
     "params_fingerprint",
+    "ring_attention",
     "DEFAULT_RULES",
     "logical_to_mesh_spec",
     "mesh_shardings",

@@ -1,0 +1,113 @@
+"""Ring attention tests: parity with dense attention (values and grads) on
+the 8-virtual-device CPU mesh, causal and full, with and without a batch
+axis — the sequence-parallel property the reference entirely lacks
+(SURVEY.md §5 long-context)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu.ops.attention import (
+    scaled_dot_product_attention,
+)
+from machine_learning_apache_spark_tpu.ops.masks import make_causal_mask
+from machine_learning_apache_spark_tpu.parallel import make_mesh
+from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+from machine_learning_apache_spark_tpu.parallel.ring_attention import (
+    ring_attention,
+)
+
+
+def qkv(b=2, h=4, s=32, d=8, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, h, s, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    return make_mesh({SEQ_AXIS: 8})
+
+
+@pytest.fixture(scope="module")
+def dp_sp_mesh():
+    return make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+
+
+class TestRingParity:
+    def test_full_attention_matches_dense(self, seq_mesh):
+        q, k, v = qkv()
+        dense = scaled_dot_product_attention(q, k, v)
+        ring = ring_attention(q, k, v, seq_mesh)
+        np.testing.assert_allclose(ring, dense, atol=1e-5)
+
+    def test_causal_matches_dense(self, seq_mesh):
+        q, k, v = qkv()
+        mask = make_causal_mask(q.shape[2])
+        dense = scaled_dot_product_attention(q, k, v, mask)
+        ring = ring_attention(q, k, v, seq_mesh, causal=True)
+        np.testing.assert_allclose(ring, dense, atol=1e-5)
+
+    def test_dp_sp_mesh(self, dp_sp_mesh):
+        q, k, v = qkv(b=4, s=16)
+        dense = scaled_dot_product_attention(q, k, v)
+        ring = ring_attention(q, k, v, dp_sp_mesh)
+        np.testing.assert_allclose(ring, dense, atol=1e-5)
+
+    def test_gradients_match_dense(self, seq_mesh):
+        q, k, v = qkv(s=16)
+
+        def dense_loss(q, k, v):
+            return (scaled_dot_product_attention(
+                q, k, v, make_causal_mask(q.shape[2])
+            ) ** 2).sum()
+
+        def ring_loss(q, k, v):
+            return (ring_attention(q, k, v, seq_mesh, causal=True) ** 2).sum()
+
+        g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        for gd, gr in zip(g_dense, g_ring):
+            np.testing.assert_allclose(gr, gd, atol=1e-4)
+
+    def test_mesh_with_unused_axes(self):
+        """A dp×tp×sp mesh (axes beyond the specs) must work — the natural
+        combined mesh once tensor parallelism is in play."""
+        from machine_learning_apache_spark_tpu.parallel.mesh import MODEL_AXIS
+
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2, SEQ_AXIS: 2})
+        q, k, v = qkv(b=4, s=16)
+        np.testing.assert_allclose(
+            ring_attention(q, k, v, mesh),
+            scaled_dot_product_attention(q, k, v),
+            atol=1e-5,
+        )
+
+    def test_no_batch_axis(self, seq_mesh):
+        q, k, v = qkv()
+        np.testing.assert_allclose(
+            ring_attention(q, k, v, seq_mesh, batch_axis=None),
+            scaled_dot_product_attention(q, k, v),
+            atol=1e-5,
+        )
+
+    def test_jit_compiles_once(self, seq_mesh):
+        q, k, v = qkv()
+        f = jax.jit(lambda q, k, v: ring_attention(q, k, v, seq_mesh))
+        np.testing.assert_allclose(
+            f(q, k, v), scaled_dot_product_attention(q, k, v), atol=1e-5
+        )
+
+
+class TestRingValidation:
+    def test_indivisible_seq_rejected(self, seq_mesh):
+        q, k, v = qkv(s=30)
+        with pytest.raises(ValueError, match="not divisible"):
+            ring_attention(q, k, v, seq_mesh)
+
+    def test_cross_shapes_rejected(self, seq_mesh):
+        q, _, _ = qkv(s=16)
+        _, k, v = qkv(s=32)
+        with pytest.raises(ValueError, match="self-attention-shaped"):
+            ring_attention(q, k, v, seq_mesh)
