@@ -35,6 +35,17 @@ class ArrayDataset:
         return len(self.arrays[0])
 
     def __getitem__(self, idx):
+        if (
+            isinstance(idx, np.ndarray)
+            and idx.ndim == 1
+            and np.issubdtype(idx.dtype, np.integer)
+        ):
+            # Batch assembly goes through the native threaded row-gather
+            # when built (numpy fancy indexing otherwise) — the loader's
+            # host-side hot path (native/batch_gather.cpp).
+            from machine_learning_apache_spark_tpu.native import gather_rows
+
+            return tuple(gather_rows(a, idx) for a in self.arrays)
         return tuple(a[idx] for a in self.arrays)
 
 

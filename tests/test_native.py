@@ -1,0 +1,142 @@
+"""Native C++ component tests: build-on-demand, libsvm parser parity with
+the Python parser, threaded gather parity with numpy fancy indexing."""
+
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu import native
+from machine_learning_apache_spark_tpu.data.libsvm import (
+    _parse_python,
+    read_libsvm,
+    write_libsvm,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+SAMPLE = """\
+1 1:-0.22 2:0.18 4:-0.48
+# a comment line
+0 1:0.5 3:1.25
+
+2 2:-1 4:0.75  # trailing comment
+"""
+
+
+class TestLibsvmParser:
+    def test_parity_with_python(self):
+        nat_f, nat_l = native.libsvm_native.parse_text(SAMPLE)
+        py_f, py_l, _ = _parse_python(SAMPLE)
+        np.testing.assert_allclose(nat_f, py_f, rtol=1e-6)
+        np.testing.assert_allclose(nat_l, py_l)
+
+    def test_shapes_and_values(self):
+        f, l = native.libsvm_native.parse_text(SAMPLE)
+        assert f.shape == (3, 4) and l.shape == (3,)
+        assert f[0, 3] == np.float32(-0.48)
+        assert f[1, 2] == np.float32(1.25)
+        assert f[2, 0] == 0.0  # sparse zero
+        np.testing.assert_array_equal(l, [1, 0, 2])
+
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError, match="line 1"):
+            native.libsvm_native.parse_text("1 0:3.0\n")  # 0-based index
+        with pytest.raises(ValueError, match="bad label"):
+            native.libsvm_native.parse_text("abc 1:2\n")
+        with pytest.raises(ValueError, match="bad index"):
+            native.libsvm_native.parse_text("1 x:2\n")
+
+    def test_read_libsvm_uses_native(self, tmp_path, rng):
+        """End-to-end: write → read via the native path → same frame as the
+        forced-Python path."""
+        features = rng.normal(size=(50, 6)).astype(np.float32)
+        features[rng.random(features.shape) < 0.5] = 0.0
+        labels = rng.integers(0, 3, 50)
+        path = str(tmp_path / "data.txt")
+        write_libsvm(path, features, labels)
+        nat = read_libsvm(path, use_native=True, num_features=6)
+        py = read_libsvm(path, use_native=False, num_features=6)
+        np.testing.assert_allclose(nat.features, py.features, rtol=1e-5)
+        np.testing.assert_array_equal(nat.labels, py.labels)
+
+    def test_empty_text(self):
+        f, l = native.libsvm_native.parse_text("\n# only comments\n")
+        assert f.shape[0] == 0 and l.shape[0] == 0
+
+    def test_subnormal_values_accepted(self):
+        """glibc strtod flags ERANGE on subnormals; they are valid values
+        (the Python parser accepts them) — only ±inf overflow is an error."""
+        f, l = native.libsvm_native.parse_text("0 1:1e-310\n")
+        assert f.shape == (1, 1) and f[0, 0] == np.float32(1e-310)
+        with pytest.raises(ValueError, match="bad value"):
+            native.libsvm_native.parse_text("0 1:1e999\n")
+
+
+class TestGatherRows:
+    @pytest.mark.parametrize(
+        "shape,dtype",
+        [((100, 7), np.float32), ((64, 28, 28, 1), np.float32),
+         ((50,), np.int64), ((200, 33), np.int32)],
+    )
+    def test_parity_with_numpy(self, rng, shape, dtype):
+        src = rng.normal(size=shape).astype(dtype)
+        idx = rng.integers(0, shape[0], 37)
+        np.testing.assert_array_equal(
+            native.gather_rows(src, idx), src[idx]
+        )
+
+    def test_large_batch_multithreaded(self, rng):
+        src = rng.normal(size=(512, 64, 64)).astype(np.float32)  # >4MB rows
+        idx = rng.integers(0, 512, 256)
+        np.testing.assert_array_equal(
+            native.gather_rows(src, idx, n_threads=4), src[idx]
+        )
+
+    def test_out_of_range_raises(self, rng):
+        src = np.arange(12.0).reshape(3, 4)
+        with pytest.raises(IndexError):
+            native.gather_rows(src, np.array([3]))
+        with pytest.raises(IndexError):
+            native.gather_rows(src, np.array([-4]))
+
+    def test_negative_indices(self):
+        src = np.arange(12.0).reshape(3, 4)
+        np.testing.assert_array_equal(
+            native.gather_rows(src, np.array([-1, 0])), src[[-1, 0]]
+        )
+
+    def test_bool_mask_uses_numpy_semantics(self, rng):
+        """Boolean masks must select rows, never be cast to indices."""
+        from machine_learning_apache_spark_tpu.data import ArrayDataset
+
+        ds = ArrayDataset(np.arange(12.0).reshape(4, 3), np.arange(4))
+        mask = np.array([False, False, True, True])
+        feats, labels = ds[mask]
+        np.testing.assert_array_equal(feats, np.arange(12.0).reshape(4, 3)[2:])
+        np.testing.assert_array_equal(labels, [2, 3])
+        with pytest.raises(IndexError):
+            native.gather_rows(np.zeros((4, 3)), mask)
+
+    def test_object_dtype_falls_back(self):
+        src = np.empty(4, dtype=object)
+        src[:] = [{"a": 1}, [2], "three", None]
+        out = native.gather_rows(src, np.array([2, 0]))
+        assert out[0] == "three" and out[1] == {"a": 1}
+
+    def test_noncontiguous_falls_back(self):
+        src = np.arange(24.0).reshape(4, 6)[:, ::2]  # non-contiguous
+        idx = np.array([2, 0])
+        np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+    def test_loader_integration(self, rng):
+        from machine_learning_apache_spark_tpu.data import ArrayDataset, DataLoader
+
+        ds = ArrayDataset(
+            rng.normal(size=(64, 5)).astype(np.float32),
+            rng.integers(0, 3, 64),
+        )
+        batches = list(DataLoader(ds, 16, shuffle=True, seed=3))
+        assert len(batches) == 4
+        assert batches[0][0].shape == (16, 5)
