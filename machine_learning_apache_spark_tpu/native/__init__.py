@@ -172,6 +172,10 @@ def gather_rows(
     # and corrupt the interpreter; strided layouts can't be row-memcpy'd.
     if not (src.flags["C_CONTIGUOUS"] and src.ndim >= 1) or src.dtype.hasobject:
         return src[indices]
+    if _build_error is not None:
+        # Memoized build failure: skip the lock + raise/catch round trip on
+        # this per-batch hot path.
+        return src[indices]
     if indices.size and (
         indices.min() < -len(src) or indices.max() >= len(src)
     ):

@@ -103,9 +103,19 @@ void* mlspark_libsvm_parse(const char* text, int64_t text_len,
         return nullptr;
       }
       p = after + 1;  // past ':'
+      // The value must start immediately after ':' within this line —
+      // strtod's own whitespace skip would otherwise run across the newline
+      // and silently consume the NEXT line's label as this value.
+      if (p >= eff_end || *p == ' ' || *p == '\t' || *p == '\r') {
+        set_err(err, err_len,
+                "malformed libsvm line " + std::to_string(lineno) +
+                    ": missing value after ':'");
+        delete result;
+        return nullptr;
+      }
       errno = 0;
       double value = std::strtod(p, &after);
-      if (strtod_failed(p, after, value)) {
+      if (after > eff_end || strtod_failed(p, after, value)) {
         set_err(err, err_len,
                 "malformed libsvm line " + std::to_string(lineno) +
                     ": bad value");

@@ -76,6 +76,8 @@ def fit(
     emit: Callable[[str], None] | None = None,
     checkpointer=None,
     checkpoint_every: int = 1,
+    profile_dir: str | None = None,
+    profile_window: tuple[int, int] = (2, 5),
 ) -> FitResult:
     """The canonical loop (``pytorch_cnn.py:125-146`` shape): epochs × batches,
     per-``log_every``-batch loss/time prints
@@ -89,16 +91,50 @@ def fit(
     ``checkpointer`` (a ``train.checkpoint.CheckpointManager``) saves the
     state every ``checkpoint_every`` epochs — persistence the reference
     lacks entirely (SURVEY.md §5 checkpoint/resume).
+
+    ``profile_dir`` captures a jax.profiler device trace over the global-step
+    window ``profile_window`` (skipping compile/warmup steps) — the tracing
+    subsystem the reference approximates with ``time.time()`` pairs
+    (SURVEY.md §5).
     """
+    from machine_learning_apache_spark_tpu.utils.profiling import StepWindowTracer
+
     emit = emit or log.info
     rng = rng if rng is not None else jax.random.key(0)
     step_fn = make_train_step(loss_fn)
+    tracer = StepWindowTracer(
+        profile_dir, start=profile_window[0], stop=profile_window[1]
+    )
     if mesh is not None:
         state = replicate(mesh, state)
 
-    history: list[dict] = []
     total_timer = Timer("train").start()
     span_timer = Timer("span").start()
+    try:
+        state, history = _run_epochs(
+            state, step_fn, train_loader, epochs, rng, mesh, log_every, emit,
+            tracer, checkpointer, checkpoint_every, span_timer,
+        )
+    finally:
+        # An exception mid-window must still stop the (process-global) jax
+        # profiler, or every later trace in this process fails to start.
+        tracer.close()
+    # Block on the final state so the reported wall-time includes device work
+    # (the reference's time.time() pairs measure eager CPU execution; under
+    # async dispatch the analogue requires a sync point).
+    jax.block_until_ready(state.params)
+    seconds = total_timer.stop()
+    if checkpointer is not None:
+        checkpointer.wait()  # durability barrier, outside the timed span
+    emit(f"Training Time: {seconds:.3f} sec")
+    return FitResult(state=state, train_seconds=seconds, history=history)
+
+
+def _run_epochs(
+    state, step_fn, train_loader, epochs, rng, mesh, log_every, emit,
+    tracer, checkpointer, checkpoint_every, span_timer,
+):
+    history: list[dict] = []
     global_step = 0
     for epoch in range(epochs):
         if hasattr(train_loader, "set_epoch"):
@@ -119,6 +155,7 @@ def fit(
             if mesh is not None:
                 batch = shard_batch(mesh, batch)
             rng, step_rng = jax.random.split(rng)
+            tracer.on_step(global_step)
             state, loss, aux = step_fn(state, batch, step_rng)
             global_step += 1
             pending.append((loss, aux))
@@ -140,15 +177,7 @@ def fit(
             # Async: orbax snapshots to host and writes in the background, so
             # checkpoint I/O never stalls device dispatch mid-training.
             checkpointer.save(state, wait=False)
-    # Block on the final state so the reported wall-time includes device work
-    # (the reference's time.time() pairs measure eager CPU execution; under
-    # async dispatch the analogue requires a sync point).
-    jax.block_until_ready(state.params)
-    seconds = total_timer.stop()
-    if checkpointer is not None:
-        checkpointer.wait()  # durability barrier, outside the timed span
-    emit(f"Training Time: {seconds:.3f} sec")
-    return FitResult(state=state, train_seconds=seconds, history=history)
+    return state, history
 
 
 def evaluate(

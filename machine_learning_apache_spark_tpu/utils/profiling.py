@@ -1,0 +1,97 @@
+"""Profiling / tracing hooks — the observability the reference lacks.
+
+The reference's only instrumentation is manual ``time.time()`` pairs
+(SURVEY.md §5 tracing: 19 sites, plus one unused ``timeit`` import at
+``pytorch_cnn.py:6``). The framework keeps that span vocabulary
+(``utils.timing``) and adds the real thing: ``jax.profiler`` device traces
+viewable in TensorBoard/XProf (compiled-step timelines, HBM usage, ICI
+collectives), plus named trace annotations that label host-side regions
+inside the trace.
+
+Usage:
+    with device_trace("/tmp/trace"):          # whole-region trace
+        run_steps()
+
+    fit(..., profile_dir="/tmp/trace")        # trace a step window mid-run
+
+    with annotate("tokenize"):                # label host work in the trace
+        pipe(texts)
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _sync_local_devices() -> None:
+    """Fence: a trivial computation per local device executes only after all
+    previously-dispatched work on that device — required before stop_trace
+    or the traced steps' device timeline is still in flight and missing."""
+    import jax.numpy as jnp
+
+    probes = [
+        jax.device_put(jnp.zeros(()), d) + 0 for d in jax.local_devices()
+    ]
+    jax.block_until_ready(probes)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a jax.profiler trace for the enclosed region into
+    ``log_dir`` (TensorBoard: ``tensorboard --logdir <log_dir>``)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        _sync_local_devices()
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", log_dir)
+
+
+def annotate(name: str, **kwargs):
+    """Named region annotation appearing on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name, **kwargs)
+
+
+def step_annotation(step: int):
+    """Marks one training step; XProf groups per-step statistics by these."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+
+
+class StepWindowTracer:
+    """Trace a ``[start, stop)`` window of steps inside a long run — the
+    usual profiling pattern: skip compile/warmup steps, capture a few steady
+    -state ones, stop before the trace gets huge.
+    """
+
+    def __init__(self, log_dir: str | None, *, start: int = 2, stop: int = 5):
+        if stop <= start:
+            raise ValueError(f"empty trace window [{start}, {stop})")
+        self.log_dir = log_dir
+        self.start, self.stop = start, stop
+        self._active = False
+
+    def on_step(self, step: int) -> None:
+        if self.log_dir is None:
+            return
+        if not self._active and step == self.start:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and step >= self.stop:
+            self.close()
+
+    def close(self) -> None:
+        if self._active:
+            _sync_local_devices()
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info(
+                "profiler trace (steps %d-%d) written to %s",
+                self.start, self.stop, self.log_dir,
+            )

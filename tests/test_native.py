@@ -65,6 +65,14 @@ class TestLibsvmParser:
         f, l = native.libsvm_native.parse_text("\n# only comments\n")
         assert f.shape[0] == 0 and l.shape[0] == 0
 
+    def test_missing_value_rejected(self):
+        """A bare '1:' must not silently consume the next line's label
+        (strtod's whitespace skip crosses newlines)."""
+        with pytest.raises(ValueError, match="missing value"):
+            native.libsvm_native.parse_text("1 1:\n0 1:5\n")
+        with pytest.raises(ValueError, match="missing value"):
+            native.libsvm_native.parse_text("1 1: 2\n")  # space after colon
+
     def test_subnormal_values_accepted(self):
         """glibc strtod flags ERANGE on subnormals; they are valid values
         (the Python parser accepts them) — only ±inf overflow is an error."""

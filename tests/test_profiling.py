@@ -1,0 +1,126 @@
+"""Profiling hook tests: device traces actually land on disk, the step-window
+tracer opens/closes correctly, and fit()'s profile_dir integration works."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu.utils.profiling import (
+    StepWindowTracer,
+    annotate,
+    device_trace,
+)
+
+
+def trace_files(log_dir: str) -> list[str]:
+    return glob.glob(
+        os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True
+    )
+
+
+class TestDeviceTrace:
+    def test_trace_written(self, tmp_path):
+        d = str(tmp_path / "trace")
+        with device_trace(d):
+            with annotate("square"):
+                jax.jit(lambda x: x * x)(jnp.arange(8.0)).block_until_ready()
+        assert trace_files(d), "no xplane trace written"
+
+
+class TestStepWindowTracer:
+    def test_window(self, tmp_path):
+        d = str(tmp_path / "w")
+        t = StepWindowTracer(d, start=1, stop=3)
+        for step in range(5):
+            t.on_step(step)
+            jnp.square(jnp.arange(4.0)).block_until_ready()
+        assert not t._active  # closed at step 3
+        assert trace_files(d)
+
+    def test_none_dir_noop(self):
+        t = StepWindowTracer(None)
+        for step in range(10):
+            t.on_step(step)
+        t.close()
+
+    def test_close_mid_window(self, tmp_path):
+        d = str(tmp_path / "mid")
+        t = StepWindowTracer(d, start=0, stop=100)
+        t.on_step(0)
+        jnp.square(jnp.arange(4.0)).block_until_ready()
+        t.close()
+        assert trace_files(d)
+
+    def test_exception_mid_window_stops_profiler(self, tmp_path):
+        """fit() failing inside the trace window must stop the process-global
+        profiler so later traces can start."""
+        from machine_learning_apache_spark_tpu.train.loop import fit
+        from machine_learning_apache_spark_tpu.train.state import (
+            TrainState,
+            make_optimizer,
+        )
+        from machine_learning_apache_spark_tpu.models import MLP
+
+        model = MLP((4, 8, 3))
+        params = model.init(jax.random.key(0), jnp.ones((1, 4)))["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.1)
+        )
+
+        def bad_loss(params, batch, rng):
+            raise RuntimeError("boom")
+
+        batches = [(np.ones((4, 4), np.float32), np.zeros(4, np.int64))] * 4
+        with pytest.raises(RuntimeError, match="boom"):
+            fit(
+                state, bad_loss, batches, epochs=1, log_every=0,
+                profile_dir=str(tmp_path / "t"), profile_window=(0, 100),
+            )
+        # profiler must be stopped: a fresh trace can start
+        with device_trace(str(tmp_path / "t2")):
+            jnp.square(jnp.arange(4.0)).block_until_ready()
+        assert trace_files(str(tmp_path / "t2"))
+
+    def test_empty_window_rejected(self):
+        with pytest.raises(ValueError):
+            StepWindowTracer("/tmp/x", start=5, stop=5)
+
+
+class TestFitIntegration:
+    def test_fit_profile_dir(self, tmp_path):
+        from machine_learning_apache_spark_tpu.data import ArrayDataset, DataLoader
+        from machine_learning_apache_spark_tpu.models import MLP
+        from machine_learning_apache_spark_tpu.train.loop import (
+            classification_loss,
+            fit,
+        )
+        from machine_learning_apache_spark_tpu.train.state import (
+            TrainState,
+            make_optimizer,
+        )
+
+        model = MLP((4, 8, 3))
+        ds = ArrayDataset(
+            np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32),
+            np.zeros(64, dtype=np.int64),
+        )
+        state = TrainState.create(
+            apply_fn=model.apply,
+            params=model.init(jax.random.key(0), ds[:1][0])["params"],
+            tx=make_optimizer("sgd", 0.03),
+        )
+        d = str(tmp_path / "fit_trace")
+        fit(
+            state,
+            classification_loss(model.apply),
+            DataLoader(ds, 16),
+            epochs=2,
+            log_every=0,
+            profile_dir=d,
+            profile_window=(1, 3),
+        )
+        assert trace_files(d)
