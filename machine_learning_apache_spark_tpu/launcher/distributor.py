@@ -102,12 +102,16 @@ class Distributor:
         platform: str | None = None,
         env: dict[str, str] | None = None,
         timeout: float = 600.0,
+        max_restarts: int = 0,
     ) -> None:
         self.num_processes = num_processes or 1
         self.local_mode = local_mode
         self.platform = platform
         self.extra_env = env or {}
         self.timeout = timeout
+        # Spark-barrier recovery semantics (SURVEY.md §5 failure detection):
+        # a failed stage is retried whole — all-or-nothing gang restarts.
+        self.max_restarts = max_restarts
 
     # -- multi-host control plane --------------------------------------------
     def commands_for_hosts(
@@ -151,7 +155,26 @@ class Distributor:
             pickle.dump((args, kwargs), f)
 
         try:
-            return self._run_gang(ref, coord, workdir, args_path, n)
+            attempt = 0
+            while True:
+                # Clear any stale result files from a failed attempt so a
+                # restart can't return a dead rank's leftovers.
+                for rank in range(n):
+                    stale = os.path.join(workdir, f"result_{rank}.pkl")
+                    if os.path.exists(stale):
+                        os.unlink(stale)
+                try:
+                    return self._run_gang(ref, coord, workdir, args_path, n)
+                except (RuntimeError, TimeoutError):
+                    attempt += 1
+                    if attempt > self.max_restarts:
+                        raise
+                    log.warning(
+                        "gang attempt %d/%d failed; restarting whole gang "
+                        "(Spark-barrier all-or-nothing semantics)",
+                        attempt, self.max_restarts,
+                    )
+                    coord = f"127.0.0.1:{_free_port()}"  # stale port may linger
         finally:
             import shutil
 

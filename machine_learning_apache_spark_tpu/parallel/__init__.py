@@ -25,6 +25,9 @@ from machine_learning_apache_spark_tpu.parallel.data_parallel import (
     pad_batch_to_multiple,
     params_fingerprint,
 )
+from machine_learning_apache_spark_tpu.parallel.pipeline_parallel import (
+    pipeline_apply,
+)
 from machine_learning_apache_spark_tpu.parallel.ring_attention import (
     ring_attention,
 )
@@ -53,6 +56,7 @@ __all__ = [
     "make_data_parallel_step",
     "pad_batch_to_multiple",
     "params_fingerprint",
+    "pipeline_apply",
     "ring_attention",
     "DEFAULT_RULES",
     "logical_to_mesh_spec",

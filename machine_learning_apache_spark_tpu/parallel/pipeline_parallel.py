@@ -1,0 +1,142 @@
+"""Pipeline parallelism — stage-wise SPMD over the mesh ``"pipeline"`` axis.
+
+The reference has no PP (SURVEY.md §2.3 lists it "not required for parity;
+leave hook documented"); this module is the working hook: a GPipe-style
+microbatch schedule expressed as one compiled SPMD program, the idiomatic
+TPU form (no per-stage processes, no send/recv runtime — ``shard_map`` +
+``ppermute`` and a ``lax.scan`` over schedule ticks).
+
+Layout: the mesh's ``pipeline`` axis has one device (group) per stage; each
+holds only its own stage's params (1/n of the model). The global batch is
+split into M microbatches. On tick t, stage s applies itself to the
+activations of microbatch t−s and passes the result to stage s+1 via a
+single-hop ``ppermute`` — after M + S − 1 ticks every microbatch has
+traversed every stage. The classic pipeline bubble (S−1 idle ticks) shrinks
+as M grows; activations cross only nearest-neighbour ICI links.
+
+All stages must share one layer shape (the homogeneous-stack case — exactly
+the Transformer encoder/decoder stack shape in the zoo); the first/last
+stages' embedding/head stay outside the pipelined region, which is standard.
+
+Differentiable end to end: the backward pass reverses the ring through the
+``ppermute`` transpose inside the scan, giving the standard reverse
+pipeline schedule for grads.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from machine_learning_apache_spark_tpu.parallel.mesh import PIPELINE_AXIS
+
+
+def _pipeline_shard_fn(stage_params, x, *, stage_fn, n_micro, axis, mesh_axes):
+    """Per-stage body under shard_map.
+
+    ``stage_params``: this stage's params (leading stage dim of size 1,
+    squeezed). ``x``: the full batch (replicated across stages),
+    ``[n_micro, micro_batch, ...]``.
+    """
+    n_stages = jax.lax.psum(1, axis)
+    stage_id = jax.lax.axis_index(axis)
+    params = jax.tree.map(lambda p: p[0], stage_params)
+
+    ticks = n_micro + n_stages - 1
+    # Fresh carries are replicated constants; mark them device-varying over
+    # the pipeline axis so the scan carry type stays uniform after ppermute.
+    varying = lambda v: jax.lax.pcast(v, tuple(mesh_axes), to="varying")
+    state = varying(jnp.zeros_like(x[0]))  # activation held by this stage
+    outputs = varying(jnp.zeros_like(x))
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t from the batch (while valid); others
+        # take what arrived from the previous stage.
+        feed = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
+        )
+        inp = jnp.where(stage_id == 0, feed, state)
+        out = stage_fn(params, inp)
+        # Microbatch m = t - stage_id finished the last stage at this tick.
+        m = t - stage_id
+        valid = (m >= 0) & (m < n_micro)
+
+        def write(outputs):
+            return jax.lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.clip(m, 0, n_micro - 1), axis=0
+            )
+
+        outputs = jnp.where(
+            valid & (stage_id == n_stages - 1), write(outputs), outputs
+        )
+        # Hand activations to the next stage (the wrap-around edge back to
+        # stage 0 carries garbage that stage 0 ignores — it always injects).
+        state = jax.lax.ppermute(out, axis, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(
+        tick, (state, outputs), jnp.arange(ticks)
+    )
+    # Only the last stage holds real outputs; broadcast them to every stage
+    # so the result leaves shard_map replicated (psum of one-hot copies).
+    outputs = jnp.where(stage_id == n_stages - 1, outputs, 0.0)
+    return jax.lax.psum(outputs, axis)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    n_micro: int | None = None,
+    axis: str = PIPELINE_AXIS,
+) -> jnp.ndarray:
+    """Run ``x`` through ``n_stages`` sequential applications of
+    ``stage_fn``, pipelined over the mesh's ``axis``.
+
+    - ``stage_fn(params, x) -> y`` with ``y.shape == x.shape`` (homogeneous
+      stack; the residual-block contract of the zoo Transformer's layers).
+    - ``stage_params``: pytree whose leaves carry a leading stage dimension
+      of size ``mesh.shape[axis]`` (stage i uses slice i).
+    - ``x``: ``[batch, ...]``; split into ``n_micro`` microbatches (defaults
+      to the stage count — more microbatches, smaller bubble).
+
+    Returns ``stage_fn^(n_stages)(x)`` exactly — parity with the sequential
+    loop is pinned by ``tests/test_pipeline_parallel.py``.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = n_micro or n_stages
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(f"batch {batch} not divisible by n_micro={n_micro}")
+    leaves = jax.tree.leaves(stage_params)
+    if not leaves:
+        raise ValueError("stage_params is empty")
+    leading = {leaf.shape[0] for leaf in leaves}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stage_params leading dim(s) {leading} != {n_stages} stages"
+        )
+
+    xs = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+    fn = jax.shard_map(
+        functools.partial(
+            _pipeline_shard_fn,
+            stage_fn=stage_fn,
+            n_micro=n_micro,
+            axis=axis,
+            mesh_axes=(axis,),
+        ),
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+    )
+    out = fn(stage_params, xs)
+    return out.reshape(batch, *x.shape[1:])

@@ -18,6 +18,21 @@ def boom():
     raise RuntimeError("worker exploded (intentional)")
 
 
+def flaky_until(marker_path):
+    """Fails the whole gang until the marker exists; every failing rank
+    writes it (any single writer could be SIGKILLed by gang teardown before
+    its write lands) — exercises the restart path."""
+    import os
+
+    if not os.path.exists(marker_path):
+        rank = os.environ.get("MLSPARK_PROCESS_ID", "?")
+        with open(f"{marker_path}.{rank}", "w") as f:
+            f.write("failed once")
+        os.replace(f"{marker_path}.{rank}", marker_path)
+        raise RuntimeError("flaky failure (intentional)")
+    return {"attempt": "recovered"}
+
+
 def unpicklable_result():
     return lambda: None  # cannot cross the result-file boundary
 

@@ -66,6 +66,20 @@ class TestDistributorLocal:
                 "launcher_workers:boom"
             )
 
+    def test_gang_restart_recovers(self, tmp_path):
+        """max_restarts re-runs the whole gang (Spark-barrier all-or-nothing
+        recovery, SURVEY.md §5): first attempt fails, second succeeds."""
+        out = Distributor(
+            num_processes=2, platform="cpu", timeout=240, max_restarts=1
+        ).run("launcher_workers:flaky_until", str(tmp_path / "marker"))
+        assert out == {"attempt": "recovered"}
+
+    def test_gang_restart_exhausted_raises(self):
+        with pytest.raises(RuntimeError, match="worker exploded"):
+            Distributor(
+                num_processes=2, platform="cpu", timeout=240, max_restarts=1
+            ).run("launcher_workers:boom")
+
     def test_unpicklable_result_reports_rank_failure(self):
         # A worker whose return value can't be pickled must surface as a gang
         # failure naming the rank — not escape as a raw EOFError/unpickling
