@@ -32,7 +32,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from machine_learning_apache_spark_tpu.ops.attention import (
-    scaled_dot_product_attention,
+    dot_product_attention,
 )
 from machine_learning_apache_spark_tpu.ops.masks import (
     combine_masks,
@@ -112,6 +112,8 @@ class MultiHeadAttention(nn.Module):
         x_kv: jnp.ndarray | None = None,
         mask: jnp.ndarray | None = None,
         *,
+        causal: bool = False,
+        kv_valid: jnp.ndarray | None = None,
         deterministic: bool = True,
     ) -> jnp.ndarray:
         cfg = self.cfg
@@ -131,8 +133,15 @@ class MultiHeadAttention(nn.Module):
             k, v = jnp.split(kv, 2, axis=-1)
             q = _dense(cfg.d_model, cfg, "q", "heads")(x_q)
 
-        out = scaled_dot_product_attention(
-            split_heads(q, s_q), split_heads(k, s_kv), split_heads(v, s_kv), mask
+        # Structured (causal/kv_valid) masks stream through the Pallas flash
+        # kernel on TPU; a dense mask falls back to the fused-XLA path.
+        out = dot_product_attention(
+            split_heads(q, s_q),
+            split_heads(k, s_kv),
+            split_heads(v, s_kv),
+            mask,
+            causal=causal,
+            kv_valid=kv_valid,
         )
         out = out.transpose(0, 2, 1, 3).reshape(b, s_q, cfg.d_model)
         return nn.Dense(
@@ -173,10 +182,12 @@ class EncoderLayer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, *, deterministic: bool = True):
+    def __call__(
+        self, x, mask=None, kv_valid=None, *, deterministic: bool = True
+    ):
         drop = nn.Dropout(self.cfg.dropout, deterministic=deterministic)
         attn = MultiHeadAttention(self.cfg, name="self_attn")(
-            x, mask=mask, deterministic=deterministic
+            x, mask=mask, kv_valid=kv_valid, deterministic=deterministic
         )
         x = nn.LayerNorm(dtype=self.cfg.dtype, name="ln1")(x + drop(attn))
         ffn = FeedForward(self.cfg, name="ffn")(x, deterministic=deterministic)
@@ -189,13 +200,20 @@ class Encoder(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, src_tokens, src_mask=None, *, deterministic: bool = True):
+    def __call__(
+        self,
+        src_tokens,
+        src_mask=None,
+        src_valid=None,
+        *,
+        deterministic: bool = True,
+    ):
         x = SentenceEmbedding(self.cfg.src_vocab_size, self.cfg, name="embed")(
             src_tokens, deterministic=deterministic
         )
         for i in range(self.cfg.num_layers):
             x = EncoderLayer(self.cfg, name=f"layer_{i}")(
-                x, src_mask, deterministic=deterministic
+                x, src_mask, src_valid, deterministic=deterministic
             )
         return x
 
@@ -208,15 +226,32 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(
-        self, y, memory, self_mask=None, cross_mask=None, *, deterministic: bool = True
+        self,
+        y,
+        memory,
+        self_mask=None,
+        cross_mask=None,
+        trg_valid=None,
+        memory_valid=None,
+        *,
+        self_causal: bool = False,
+        deterministic: bool = True,
     ):
         drop = nn.Dropout(self.cfg.dropout, deterministic=deterministic)
         attn = MultiHeadAttention(self.cfg, name="self_attn")(
-            y, mask=self_mask, deterministic=deterministic
+            y,
+            mask=self_mask,
+            causal=self_causal,
+            kv_valid=trg_valid,
+            deterministic=deterministic,
         )
         y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln1")(y + drop(attn))
         cross = MultiHeadAttention(self.cfg, name="cross_attn")(
-            y, memory, mask=cross_mask, deterministic=deterministic
+            y,
+            memory,
+            mask=cross_mask,
+            kv_valid=memory_valid,
+            deterministic=deterministic,
         )
         y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln2")(y + drop(cross))
         ffn = FeedForward(self.cfg, name="ffn")(y, deterministic=deterministic)
@@ -233,7 +268,10 @@ class Decoder(nn.Module):
         memory,
         self_mask=None,
         cross_mask=None,
+        trg_valid=None,
+        memory_valid=None,
         *,
+        self_causal: bool = False,
         deterministic: bool = True,
     ):
         y = SentenceEmbedding(self.cfg.trg_vocab_size, self.cfg, name="embed")(
@@ -241,7 +279,14 @@ class Decoder(nn.Module):
         )
         for i in range(self.cfg.num_layers):
             y = DecoderLayer(self.cfg, name=f"layer_{i}")(
-                y, memory, self_mask, cross_mask, deterministic=deterministic
+                y,
+                memory,
+                self_mask,
+                cross_mask,
+                trg_valid,
+                memory_valid,
+                self_causal=self_causal,
+                deterministic=deterministic,
             )
         return y
 
@@ -282,24 +327,35 @@ class Transformer(nn.Module):
         deterministic: bool = True,
     ) -> jnp.ndarray:
         pad = self.cfg.pad_id
-        if src_mask is None:
-            src_mask = make_padding_mask(src_tokens, pad)
-        if trg_mask is None:
-            trg_mask = combine_masks(
-                make_causal_mask(trg_tokens.shape[-1]),
-                make_padding_mask(trg_tokens, pad),
-            )
-        if cross_mask is None:
-            # Decoder queries over encoder keys: mask padded *source* keys.
-            cross_mask = make_padding_mask(src_tokens, pad)
-        memory = self.encoder(src_tokens, src_mask, deterministic=deterministic)
+        # Default masks stay *structured* — per-key validity vectors plus a
+        # causal flag — so TPU runs stream them through the flash kernel
+        # without materializing [B, Sq, Sk] (an explicit dense mask override
+        # still takes the fused-XLA path).
+        src_valid = (src_tokens != pad) if src_mask is None else None
+        trg_valid = (trg_tokens != pad) if trg_mask is None else None
+        # Cross-attention defaults to masking padded *source* keys whenever
+        # the caller did not override cross_mask — independent of whether
+        # src_mask was overridden (each attention site keeps its own default).
+        memory_valid = (src_tokens != pad) if cross_mask is None else None
+        memory = self.encoder(
+            src_tokens, src_mask, src_valid, deterministic=deterministic
+        )
         y = self.decoder(
-            trg_tokens, memory, trg_mask, cross_mask, deterministic=deterministic
+            trg_tokens,
+            memory,
+            trg_mask,
+            cross_mask,
+            trg_valid,
+            memory_valid,
+            self_causal=trg_mask is None,
+            deterministic=deterministic,
         )
         return self.lm_head(y)
 
     def encode(self, src_tokens, *, deterministic: bool = True):
         return self.encoder(
-            src_tokens, make_padding_mask(src_tokens, self.cfg.pad_id),
+            src_tokens,
+            None,
+            src_tokens != self.cfg.pad_id,
             deterministic=deterministic,
         )

@@ -76,30 +76,42 @@ def dot_product_attention(
     mask: jnp.ndarray | None = None,
     *,
     causal: bool = False,
+    kv_valid: jnp.ndarray | None = None,
     use_pallas: bool | None = None,
 ) -> jnp.ndarray:
     """Backend-dispatching attention entry point used by the model zoo.
 
-    ``use_pallas=None`` auto-selects the Pallas flash kernel on TPU when the
-    mask is either absent or purely causal (the kernel handles causality
-    internally); anything else falls back to the fused-XLA path.
+    Masking comes in two forms:
+
+    - dense ``mask`` (boolean, broadcastable to ``[..., Sq, Sk]``) — always
+      takes the fused-XLA path (an arbitrary mask cannot stream through the
+      blockwise kernel);
+    - structured ``causal`` + ``kv_valid`` (``[B, S_k]`` per-key validity,
+      the padding-mask case) — exactly the masks the zoo Transformer needs,
+      streamed through the Pallas flash kernel on TPU without ever
+      materializing ``[B, Sq, Sk]``.
+
+    ``use_pallas=None`` auto-selects the flash kernel on TPU whenever the
+    mask is structured-only.
     """
     if use_pallas is None:
-        use_pallas = (
-            jax.default_backend() == "tpu" and mask is None
-        )
+        use_pallas = jax.default_backend() == "tpu" and mask is None
     if use_pallas and mask is None:
         from machine_learning_apache_spark_tpu.ops.pallas_attention import (
             flash_attention,
         )
 
-        return flash_attention(query, key, value, causal=causal)
-    if causal:
-        from machine_learning_apache_spark_tpu.ops.masks import (
-            combine_masks,
-            make_causal_mask,
+        return flash_attention(
+            query, key, value, causal=causal, kv_valid=kv_valid
         )
+    from machine_learning_apache_spark_tpu.ops.masks import (
+        combine_masks,
+        make_causal_mask,
+    )
 
+    if kv_valid is not None:
+        mask = combine_masks(mask, kv_valid[:, None, None, :])
+    if causal:
         mask = combine_masks(
             mask, make_causal_mask(query.shape[-2], key.shape[-2])
         )

@@ -37,11 +37,8 @@ def _flash_kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    m_scr,
-    l_scr,
-    acc_scr,
-    *,
+    *refs,
+    has_kv_valid: bool,
     causal: bool,
     causal_offset: int,
     kv_len: int,
@@ -50,6 +47,13 @@ def _flash_kernel(
     num_k_blocks: int,
     scale: float,
 ):
+    # The kv_valid operand exists only when a mask was passed — the unmasked
+    # hot path pays no extra HBM traffic or per-tile AND.
+    if has_kv_valid:
+        kv_valid_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        kv_valid_ref = None
+        o_ref, m_scr, l_scr, acc_scr = refs
     i = pl.program_id(1)  # query-block index
     j = pl.program_id(2)  # key-block index (innermost, sequential)
 
@@ -81,6 +85,9 @@ def _flash_kernel(
             jnp.int32, (block_q, block_k), 1
         )
         mask = k_idx < kv_len  # wrapper zero-pads K; padded keys masked here
+        if has_kv_valid:
+            # Per-key validity (padding mask): [1, block_k] over rows.
+            mask = mask & (kv_valid_ref[0] != 0)
         if causal:
             # Bottom-right-aligned diagonal: the last real query row sees all
             # kv_len keys even when q_len != kv_len (decode convention).
@@ -92,7 +99,10 @@ def _flash_kernel(
 
         m_prev = m_scr[:]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_cur)
+        # Explicit zero for masked entries: when a row's running max is still
+        # NEG_INF (no valid key seen yet), exp(s - m) would be exp(0)=1 and
+        # silently average V; zeroing keeps l=0 so _finalize emits zeros.
+        p = jnp.where(mask, jnp.exp(s - m_cur), 0.0)
         alpha = jnp.exp(m_prev - m_cur)
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -128,6 +138,7 @@ def flash_attention(
     value: jnp.ndarray,
     *,
     causal: bool = False,
+    kv_valid: jnp.ndarray | None = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
@@ -137,7 +148,80 @@ def flash_attention(
     Query/key lengths may differ (fixing reference quirk Q8). Head dim is
     zero-padded to the 128-lane boundary; sequence dims to the block size —
     padding is masked inside the kernel and sliced off the output.
+
+    ``kv_valid`` (``[B, S_k]`` bool) masks invalid keys per batch row — the
+    padding-mask case of the MT model (``make_padding_mask`` semantics),
+    streamed through the kernel instead of materializing ``[B, Sq, Sk]``.
+
+    Differentiable: the forward pass streams through the kernel; the
+    backward recomputes attention on the fused-XLA path (a dedicated Pallas
+    backward kernel is the documented follow-up — for long-context
+    *training* memory the sequence-sharded ``parallel.ring_attention`` is
+    the intended path).
     """
+    cfg = (causal, block_q, block_k, interpret)
+    if kv_valid is None:
+        return _flash_vjp_nomask(cfg, query, key, value)
+    return _flash_vjp_masked(cfg, query, key, value, kv_valid)
+
+
+def _dense_reference(query, key, value, causal, kv_valid):
+    from machine_learning_apache_spark_tpu.ops.attention import (
+        dot_product_attention,
+    )
+
+    # One source of truth for structured→dense mask semantics.
+    return dot_product_attention(
+        query, key, value, causal=causal, kv_valid=kv_valid, use_pallas=False
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_vjp_nomask(cfg, query, key, value):
+    return _flash_forward(query, key, value, None, *cfg)
+
+
+def _flash_nomask_fwd(cfg, query, key, value):
+    return _flash_vjp_nomask(cfg, query, key, value), (query, key, value)
+
+
+def _flash_nomask_bwd(cfg, res, g):
+    query, key, value = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _dense_reference(q, k, v, cfg[0], None),
+        query, key, value,
+    )
+    return vjp(g)
+
+
+_flash_vjp_nomask.defvjp(_flash_nomask_fwd, _flash_nomask_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_vjp_masked(cfg, query, key, value, kv_valid):
+    return _flash_forward(query, key, value, kv_valid, *cfg)
+
+
+def _flash_masked_fwd(cfg, query, key, value, kv_valid):
+    out = _flash_vjp_masked(cfg, query, key, value, kv_valid)
+    return out, (query, key, value, kv_valid)
+
+
+def _flash_masked_bwd(cfg, res, g):
+    query, key, value, kv_valid = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _dense_reference(q, k, v, cfg[0], kv_valid),
+        query, key, value,
+    )
+    return (*vjp(g), None)
+
+
+_flash_vjp_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
+
+
+def _flash_forward(
+    query, key, value, kv_valid, causal, block_q, block_k, interpret
+):
     b, h, q_len, d = query.shape
     kv_len = key.shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -158,8 +242,28 @@ def flash_attention(
     num_q_blocks = q_pad // block_q
     num_k_blocks = k_pad // block_k
 
+    operands = [q, k, v]
+    valid_specs = []
+    if kv_valid is not None:
+        if kv_valid.shape != (b, kv_len):
+            raise ValueError(
+                f"kv_valid must be [batch={b}, kv_len={kv_len}], "
+                f"got {kv_valid.shape}"
+            )
+        # [B, 1, k_pad]: a singleton middle dim keeps the TPU block tiling
+        # legal (block dim -2 == array dim -2); batch row = grid0 // heads.
+        operands.append(
+            _pad_to(kv_valid.astype(jnp.int32), 1, block_k)[:, None, :]
+        )
+        valid_specs.append(
+            pl.BlockSpec(
+                (1, 1, block_k), lambda bh_i, i, j, h=h: (bh_i // h, 0, j)
+            )
+        )
+
     kernel = functools.partial(
         _flash_kernel,
+        has_kv_valid=kv_valid is not None,
         causal=causal,
         causal_offset=kv_len - q_len,
         kv_len=kv_len,
@@ -175,6 +279,7 @@ def flash_attention(
             pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            *valid_specs,
         ],
         out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, q_pad, d_pad), query.dtype),
@@ -187,6 +292,6 @@ def flash_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
 
     return out.reshape(b, h, q_pad, d_pad)[:, :, :q_len, :d]
