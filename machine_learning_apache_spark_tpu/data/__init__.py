@@ -9,6 +9,10 @@ from machine_learning_apache_spark_tpu.data.loader import (
     DataLoader,
     random_split,
 )
+from machine_learning_apache_spark_tpu.data.bucketing import (
+    BucketByLengthLoader,
+    assign_buckets,
+)
 from machine_learning_apache_spark_tpu.data.text import (
     PAD_ID,
     SOS_ID,
@@ -50,6 +54,8 @@ __all__ = [
     "UNK_ID",
     "TextPipeline",
     "Vocab",
+    "BucketByLengthLoader",
+    "assign_buckets",
     "classification_pipeline",
     "get_tokenizer",
     "translation_pipelines",

@@ -1,0 +1,169 @@
+"""Length bucketing — ragged text batching without wasted FLOPs.
+
+The reference sidesteps raggedness by padding everything to one fixed length
+(128 for AG_NEWS, exactly 200 for Multi30k — SURVEY.md §7 hard parts), so a
+12-token sentence burns the same compute as a 200-token one. XLA wants
+static shapes, but it does not want *one* shape: bucketing pads each batch
+to the smallest boundary that fits it — a handful of distinct XLA programs
+(one compile each), and attention/scan FLOPs scale with the bucket, not the
+corpus maximum.
+
+``BucketByLengthLoader`` groups examples by length into boundary buckets,
+shuffles within buckets per epoch (``set_epoch`` contract), and yields
+``(ids[B, boundary], *extras)`` batches in a bucket-interleaved order.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+
+from machine_learning_apache_spark_tpu.data.text import PAD_ID, PadToLength
+
+
+def assign_buckets(
+    lengths: np.ndarray, boundaries: Sequence[int]
+) -> np.ndarray:
+    """Index of the smallest boundary ≥ length; longer sequences land in the
+    last bucket (and are truncated to it at padding time)."""
+    boundaries = np.asarray(sorted(boundaries))
+    return np.minimum(
+        np.searchsorted(boundaries, np.asarray(lengths)),
+        len(boundaries) - 1,
+    )
+
+
+class BucketByLengthLoader:
+    """Minibatches of bucket-padded token ids (plus parallel extras).
+
+    >>> loader = BucketByLengthLoader(pipe.ragged(texts), labels,
+    ...                               batch_size=32,
+    ...                               boundaries=(32, 64, 128))
+    >>> for ids, lbls in loader: ...   # ids.shape[1] ∈ {32, 64, 128}
+
+    ``drop_last=True`` drops each bucket's ragged tail so every batch of a
+    bucket shares one shape. Batch order interleaves buckets
+    deterministically per epoch (seeded), so training sees a mix of lengths
+    rather than all-short-then-all-long.
+
+    Sequences longer than the largest boundary are an error unless
+    ``truncate_overlong=True`` (the same eos-clipping guard
+    ``TextPipeline`` applies to ``fixed_len``).
+
+    ``num_replicas``/``rank`` (defaulting to the JAX process layout, like
+    ``DistributedSampler``) give each rank a disjoint per-epoch slice of
+    every bucket — the loader honors the same sharding contract as the
+    rest of the data layer.
+    """
+
+    def __init__(
+        self,
+        sequences: Sequence[Sequence[int]],
+        *extras: np.ndarray,
+        batch_size: int,
+        boundaries: Sequence[int] = (32, 64, 128),
+        pad_id: int = PAD_ID,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 0,
+        truncate_overlong: bool = False,
+        num_replicas: int | None = None,
+        rank: int | None = None,
+    ) -> None:
+        if not boundaries:
+            raise ValueError("need at least one bucket boundary")
+        for e in extras:
+            if len(e) != len(sequences):
+                raise ValueError(
+                    f"extra array length {len(e)} != {len(sequences)}"
+                )
+        self.sequences = [list(s) for s in sequences]
+        self.extras = tuple(np.asarray(e) for e in extras)
+        self.batch_size = batch_size
+        self.boundaries = tuple(sorted(boundaries))
+        self.pad_id = pad_id
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.num_replicas = (
+            num_replicas if num_replicas is not None else jax.process_count()
+        )
+        self.rank = rank if rank is not None else jax.process_index()
+        if not (0 <= self.rank < self.num_replicas):
+            raise ValueError(f"rank {self.rank} outside [0, {self.num_replicas})")
+        self._epoch = 0
+        lengths = np.asarray([len(s) for s in self.sequences])
+        longest = int(lengths.max(initial=0))
+        if longest > self.boundaries[-1] and not truncate_overlong:
+            raise ValueError(
+                f"sequence of length {longest} exceeds the largest bucket "
+                f"boundary {self.boundaries[-1]}; tokens (incl. eos) would "
+                "be silently clipped — raise the boundary or pass "
+                "truncate_overlong=True"
+            )
+        bucket_ids = assign_buckets(lengths, self.boundaries)
+        self._buckets = [
+            np.flatnonzero(bucket_ids == i) for i in range(len(self.boundaries))
+        ]
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def _pad(self, idx: np.ndarray, width: int) -> np.ndarray:
+        rows = PadToLength(width, self.pad_id)(
+            [self.sequences[i] for i in idx]
+        )
+        return np.asarray(rows, dtype=np.int32)
+
+    def _rank_slice(self, order: np.ndarray) -> np.ndarray:
+        """This rank's disjoint share of one bucket's (permuted) members —
+        the same seed on every rank keeps the slices consistent."""
+        return order[self.rank :: self.num_replicas]
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed + self._epoch)
+        batches: list[tuple[int, np.ndarray]] = []
+        for b, members in enumerate(self._buckets):
+            order = rng.permutation(members) if self.shuffle else members
+            order = self._rank_slice(order)
+            stop = (
+                len(order) - self.batch_size + 1
+                if self.drop_last
+                else len(order)
+            )
+            for start in range(0, max(stop, 0), self.batch_size):
+                batches.append((b, order[start : start + self.batch_size]))
+        if self.shuffle:
+            batches = [batches[i] for i in rng.permutation(len(batches))]
+        for b, idx in batches:
+            ids = self._pad(idx, self.boundaries[b])
+            yield (ids, *(e[idx] for e in self.extras))
+
+    def __len__(self) -> int:
+        total = 0
+        for members in self._buckets:
+            n = len(self._rank_slice(members))
+            if self.drop_last:
+                total += n // self.batch_size
+            else:
+                total += -(-n // self.batch_size)
+        return total
+
+    @property
+    def padding_efficiency(self) -> float:
+        """Real tokens / padded slots over one epoch — the FLOP-waste
+        metric bucketing improves (1.0 = no padding waste)."""
+        real = padded = 0
+        for b, members in enumerate(self._buckets):
+            width = self.boundaries[b]
+            n = (
+                (len(members) // self.batch_size) * self.batch_size
+                if self.drop_last
+                else len(members)
+            )
+            chosen = members[:n]
+            real += sum(min(len(self.sequences[i]), width) for i in chosen)
+            padded += n * width
+        return real / padded if padded else 1.0

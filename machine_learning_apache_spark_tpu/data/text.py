@@ -295,6 +295,17 @@ class TextPipeline:
     def __call__(self, texts: Sequence[str]) -> np.ndarray:
         return self.transform([self.tokenizer(t) for t in texts])
 
+    def ragged(self, texts: Sequence[str]) -> list[list[int]]:
+        """Token-id lists *before* rectangularization — the input to length
+        bucketing (``data.bucketing``), which pads per-bucket instead of
+        per-corpus."""
+        batch = [self.tokenizer(t) for t in texts]
+        for t in self.transform.transforms:
+            if isinstance(t, (PadToLength, ToArray)):
+                continue
+            batch = t(batch)
+        return batch
+
     @classmethod
     def fit(
         cls,

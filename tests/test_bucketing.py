@@ -1,0 +1,109 @@
+"""Length-bucketing tests: bucket assignment, per-bucket shapes, epoch
+shuffling, padding-efficiency gain over fixed-length padding (the SURVEY.md
+§7 'ragged text batching' hard part)."""
+
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu.data.bucketing import (
+    BucketByLengthLoader,
+    assign_buckets,
+)
+from machine_learning_apache_spark_tpu.data.datasets import (
+    synthetic_text_classification,
+)
+from machine_learning_apache_spark_tpu.data.text import (
+    PAD_ID,
+    classification_pipeline,
+)
+
+
+class TestAssignBuckets:
+    def test_boundaries(self):
+        out = assign_buckets(np.array([1, 32, 33, 64, 100, 500]), (32, 64, 128))
+        np.testing.assert_array_equal(out, [0, 0, 1, 1, 2, 2])
+
+
+class TestLoader:
+    def make(self, n=400, **kw):
+        texts, labels = synthetic_text_classification(n, max_len=30)
+        pipe = classification_pipeline(texts, max_seq_len=64)
+        ragged = pipe.ragged(texts)
+        defaults = dict(batch_size=16, boundaries=(12, 20, 34), seed=3)
+        defaults.update(kw)
+        return BucketByLengthLoader(ragged, labels, **defaults), ragged, labels
+
+    def test_shapes_are_bucket_boundaries(self):
+        loader, ragged, _ = self.make()
+        widths = set()
+        for ids, lbls in loader:
+            assert ids.shape[0] == 16 and lbls.shape == (16,)
+            widths.add(ids.shape[1])
+        assert widths <= {12, 20, 34} and len(widths) >= 2
+
+    def test_content_preserved(self):
+        loader, ragged, labels = self.make(shuffle=False)
+        seen = 0
+        for ids, lbls in loader:
+            for row, lbl in zip(ids, lbls):
+                # row must equal some source sequence (padded)
+                nonpad = row[row != PAD_ID].tolist()
+                src = [i for i in np.flatnonzero(labels == lbl)
+                       if ragged[i][: ids.shape[1]] == nonpad]
+                assert src, "padded row does not match any source sequence"
+                seen += 1
+        assert seen > 0
+
+    def test_epoch_reshuffles(self):
+        loader, _, _ = self.make()
+        first = [ids.shape[1] for ids, _ in loader]
+        loader.set_epoch(1)
+        second = [ids.shape[1] for ids, _ in loader]
+        assert len(first) == len(second) == len(loader)
+        assert first != second  # interleaving order changed
+
+    def test_efficiency_beats_fixed_padding(self):
+        loader, ragged, _ = self.make()
+        fixed_width = max(len(s) for s in ragged)
+        fixed_eff = sum(len(s) for s in ragged) / (len(ragged) * fixed_width)
+        assert loader.padding_efficiency > fixed_eff
+        assert loader.padding_efficiency > 0.7
+
+    def test_mismatched_extras_rejected(self):
+        with pytest.raises(ValueError, match="extra array"):
+            BucketByLengthLoader(
+                [[1, 2]], np.zeros(5), batch_size=1, boundaries=(8,)
+            )
+
+    def test_overlong_rejected_by_default(self):
+        """Silent clipping (which would drop eos) is an error unless opted
+        into — the TextPipeline fixed_len guard's analogue."""
+        with pytest.raises(ValueError, match="truncate_overlong"):
+            BucketByLengthLoader(
+                [list(range(1, 50))], batch_size=1, boundaries=(8, 16)
+            )
+
+    def test_overlong_truncated_when_opted_in(self):
+        loader = BucketByLengthLoader(
+            [list(range(1, 50))] * 4, batch_size=2, boundaries=(8, 16),
+            shuffle=False, truncate_overlong=True,
+        )
+        for (ids,) in loader:
+            assert ids.shape[1] == 16
+            np.testing.assert_array_equal(ids[0], np.arange(1, 17))
+
+    def test_rank_sharding_disjoint_and_complete(self):
+        """Two ranks with the same seed cover every example exactly once
+        per epoch (the DistributedSampler contract)."""
+        seqs = [[7] * (4 + i % 9) for i in range(120)]
+        tags = np.arange(120)
+        rows = {0: set(), 1: set()}
+        for rank in (0, 1):
+            loader = BucketByLengthLoader(
+                seqs, tags, batch_size=4, boundaries=(8, 16),
+                num_replicas=2, rank=rank, drop_last=False, seed=5,
+            )
+            for _, t in loader:
+                rows[rank].update(t.tolist())
+        assert rows[0] & rows[1] == set()
+        assert rows[0] | rows[1] == set(range(120))
