@@ -118,12 +118,20 @@ class BucketByLengthLoader:
         return np.asarray(rows, dtype=np.int32)
 
     def _rank_slice(self, order: np.ndarray) -> np.ndarray:
-        """This rank's disjoint share of one bucket's (permuted) members —
-        the same seed on every rank keeps the slices consistent."""
-        return order[self.rank :: self.num_replicas]
+        """This rank's share of one bucket's (permuted) members, padded by
+        wrapping so every rank gets the same count — the equal-count
+        invariant collectives depend on (``DistributedSampler`` semantics).
+        The same seed on every rank keeps the slices consistent."""
+        if len(order) == 0:
+            return order
+        per_rank = -(-len(order) // self.num_replicas)
+        wrapped = np.resize(order, per_rank * self.num_replicas)
+        return wrapped[self.rank :: self.num_replicas]
 
-    def __iter__(self):
-        rng = np.random.default_rng(self.seed + self._epoch)
+    def _schedule(self, epoch: int) -> list[tuple[int, np.ndarray]]:
+        """One epoch's (bucket, example-indices) batch list — the single
+        source of truth for __iter__/__len__/padding_efficiency."""
+        rng = np.random.default_rng(self.seed + epoch)
         batches: list[tuple[int, np.ndarray]] = []
         for b, members in enumerate(self._buckets):
             order = rng.permutation(members) if self.shuffle else members
@@ -137,33 +145,23 @@ class BucketByLengthLoader:
                 batches.append((b, order[start : start + self.batch_size]))
         if self.shuffle:
             batches = [batches[i] for i in rng.permutation(len(batches))]
-        for b, idx in batches:
+        return batches
+
+    def __iter__(self):
+        for b, idx in self._schedule(self._epoch):
             ids = self._pad(idx, self.boundaries[b])
             yield (ids, *(e[idx] for e in self.extras))
 
     def __len__(self) -> int:
-        total = 0
-        for members in self._buckets:
-            n = len(self._rank_slice(members))
-            if self.drop_last:
-                total += n // self.batch_size
-            else:
-                total += -(-n // self.batch_size)
-        return total
+        return len(self._schedule(self._epoch))
 
     @property
     def padding_efficiency(self) -> float:
-        """Real tokens / padded slots over one epoch — the FLOP-waste
-        metric bucketing improves (1.0 = no padding waste)."""
+        """Real tokens / padded slots over this epoch's actual batches —
+        the FLOP-waste metric bucketing improves (1.0 = no padding)."""
         real = padded = 0
-        for b, members in enumerate(self._buckets):
+        for b, idx in self._schedule(self._epoch):
             width = self.boundaries[b]
-            n = (
-                (len(members) // self.batch_size) * self.batch_size
-                if self.drop_last
-                else len(members)
-            )
-            chosen = members[:n]
-            real += sum(min(len(self.sequences[i]), width) for i in chosen)
-            padded += n * width
+            real += sum(min(len(self.sequences[i]), width) for i in idx)
+            padded += len(idx) * width
         return real / padded if padded else 1.0

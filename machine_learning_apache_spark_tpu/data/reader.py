@@ -39,4 +39,15 @@ class DataReader:
         if self._format == "csv":
             raw = np.loadtxt(path, delimiter=",", dtype=np.float32)
             return ArrayFrame(raw[:, :-1], raw[:, -1].astype(np.int64))
+        if self._format == "image":
+            from machine_learning_apache_spark_tpu.data.datasets import (
+                load_fashion_mnist,
+            )
+
+            split = str(self._options.get("split", "train")).lower()
+            if split not in ("train", "test", "t10k"):
+                raise ValueError(
+                    f"image split must be 'train' or 'test', got {split!r}"
+                )
+            return load_fashion_mnist(path, train=split == "train")
         raise ValueError(f"unsupported format {self._format!r}")

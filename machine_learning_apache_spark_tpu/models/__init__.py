@@ -10,6 +10,7 @@ from machine_learning_apache_spark_tpu.models.cnn import TinyVGG, FashionMNISTMo
 from machine_learning_apache_spark_tpu.models.lstm import LSTMClassifier
 from machine_learning_apache_spark_tpu.models.transformer import (
     Transformer,
+    greedy_translate,
     Encoder,
     Decoder,
     TransformerConfig,
@@ -21,6 +22,7 @@ __all__ = [
     "FashionMNISTModel",
     "LSTMClassifier",
     "Transformer",
+    "greedy_translate",
     "Encoder",
     "Decoder",
     "TransformerConfig",

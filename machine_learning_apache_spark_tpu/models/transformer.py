@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from machine_learning_apache_spark_tpu.ops.attention import (
@@ -359,3 +360,72 @@ class Transformer(nn.Module):
             src_tokens != self.cfg.pad_id,
             deterministic=deterministic,
         )
+
+    def decode_logits(self, trg_tokens, memory, src_valid):
+        """One decoder pass → vocab logits, for the generation loop (no
+        dropout; causal + padding via structured masks)."""
+        y = self.decoder(
+            trg_tokens,
+            memory,
+            None,
+            None,
+            trg_tokens != self.cfg.pad_id,
+            src_valid,
+            self_causal=True,
+            deterministic=True,
+        )
+        return self.lm_head(y)
+
+
+def greedy_translate(
+    model: "Transformer",
+    params,
+    src_tokens: jnp.ndarray,
+    *,
+    max_new_tokens: int | None = None,
+    sos_id: int = 1,
+    eos_id: int = 2,
+) -> jnp.ndarray:
+    """Greedy decoding for the MT model — the inference path the reference
+    never ships (it trains and discards, quirk Q7 / SURVEY.md §5).
+
+    Re-runs the full decoder per emitted token over a fixed-width buffer
+    (static shapes; one compile). O(L²) decoder work — the simple faithful
+    path; a KV-cache incremental decoder is the documented follow-up.
+    Generates exactly ``max_new_tokens`` tokens (default: ``cfg.max_len - 1``)
+    after the leading ``sos``; returns ``[B, max_new_tokens + 1]`` int32 ids,
+    rows padded after their ``eos``.
+    """
+    cfg = model.cfg
+    pad = cfg.pad_id
+    if max_new_tokens is None:
+        max_new_tokens = cfg.max_len - 1
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    length = max_new_tokens + 1  # + the sos slot
+    src_valid = src_tokens != pad
+    memory = model.apply(
+        {"params": params}, src_tokens, method=Transformer.encode
+    )
+
+    ys = jnp.full((src_tokens.shape[0], length), pad, jnp.int32)
+    ys = ys.at[:, 0].set(sos_id)
+    finished = jnp.zeros(src_tokens.shape[0], bool)
+
+    def step(carry, t):
+        ys, finished = carry
+        logits = model.apply(
+            {"params": params},
+            ys,
+            memory,
+            src_valid,
+            method=Transformer.decode_logits,
+        )
+        nxt = jnp.argmax(logits[:, t, :], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, pad, nxt)
+        finished = finished | (nxt == eos_id)
+        ys = jax.lax.dynamic_update_index_in_dim(ys, nxt, t + 1, axis=1)
+        return (ys, finished), None
+
+    (ys, _), _ = jax.lax.scan(step, (ys, finished), jnp.arange(length - 1))
+    return ys

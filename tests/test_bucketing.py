@@ -107,3 +107,17 @@ class TestLoader:
                 rows[rank].update(t.tolist())
         assert rows[0] & rows[1] == set()
         assert rows[0] | rows[1] == set(range(120))
+
+    def test_rank_batch_counts_equal_with_odd_bucket(self):
+        """Uneven buckets wrap-pad so every rank yields the SAME number of
+        batches — the equal-count invariant collectives depend on."""
+        seqs = [[7] * 5 for _ in range(11)]  # one bucket, 11 members
+        lens = []
+        for rank in (0, 1):
+            loader = BucketByLengthLoader(
+                seqs, batch_size=2, boundaries=(8,),
+                num_replicas=2, rank=rank, seed=1,
+            )
+            lens.append((len(loader), sum(1 for _ in loader)))
+        assert lens[0] == lens[1]
+        assert lens[0][0] == lens[0][1] == 3  # ceil(11/2)=6 → 3 batches

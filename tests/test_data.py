@@ -103,6 +103,27 @@ class TestReaderAPI:
         assert frame.num_features == 2
         np.testing.assert_array_equal(frame.labels, [0, 1])
 
+    def test_image_format(self, tmp_path):
+        """``read.format("image")`` loads the FashionMNIST idx layout."""
+        import gzip
+        import struct
+
+        from machine_learning_apache_spark_tpu.data.reader import DataReader
+
+        raw = tmp_path / "FashionMNIST" / "raw"
+        raw.mkdir(parents=True)
+        images = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+        labels = np.array([3, 7], dtype=np.uint8)
+        with gzip.open(raw / "train-images-idx3-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">I", 0x00000803) + struct.pack(">III", 2, 28, 28))
+            f.write(images.tobytes())
+        with gzip.open(raw / "train-labels-idx1-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">I", 0x00000801) + struct.pack(">I", 2))
+            f.write(labels.tobytes())
+        frame = DataReader().format("image").load(str(tmp_path))
+        assert frame.features.shape == (2, 28, 28, 1)
+        np.testing.assert_array_equal(frame.labels, [3, 7])
+
     def test_unknown_format(self):
         from machine_learning_apache_spark_tpu.data.reader import DataReader
 
