@@ -11,6 +11,7 @@ from machine_learning_apache_spark_tpu.models.lstm import LSTMClassifier
 from machine_learning_apache_spark_tpu.models.transformer import (
     Transformer,
     greedy_translate,
+    greedy_translate_cached,
     Encoder,
     Decoder,
     TransformerConfig,
@@ -23,6 +24,7 @@ __all__ = [
     "LSTMClassifier",
     "Transformer",
     "greedy_translate",
+    "greedy_translate_cached",
     "Encoder",
     "Decoder",
     "TransformerConfig",

@@ -81,7 +81,13 @@ class SentenceEmbedding(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray, *, deterministic: bool = True):
+    def __call__(
+        self,
+        tokens: jnp.ndarray,
+        *,
+        deterministic: bool = True,
+        position_offset: jnp.ndarray | int = 0,
+    ):
         x = nn.Embed(
             self.vocab_size,
             self.cfg.d_model,
@@ -91,7 +97,18 @@ class SentenceEmbedding(nn.Module):
             ),
             name="embed",
         )(tokens)
-        pe = sinusoidal_encoding(tokens.shape[-1], self.cfg.d_model, self.cfg.dtype)
+        # position_offset shifts the PE window for incremental decoding
+        # (token t of the generation loop gets PE row t, not 0). The table
+        # covers max(cfg.max_len, L) so static sequences longer than max_len
+        # keep working; only dynamic offsets are bounded by max_len.
+        table = sinusoidal_encoding(
+            max(self.cfg.max_len, tokens.shape[-1]),
+            self.cfg.d_model,
+            self.cfg.dtype,
+        )
+        pe = jax.lax.dynamic_slice_in_dim(
+            table, position_offset, tokens.shape[-1], axis=0
+        )
         x = x + pe
         return nn.Dropout(self.cfg.dropout, deterministic=deterministic)(x)
 
@@ -115,6 +132,7 @@ class MultiHeadAttention(nn.Module):
         *,
         causal: bool = False,
         kv_valid: jnp.ndarray | None = None,
+        decode: bool = False,
         deterministic: bool = True,
     ) -> jnp.ndarray:
         cfg = self.cfg
@@ -133,6 +151,51 @@ class MultiHeadAttention(nn.Module):
             kv = _dense(2 * cfg.d_model, cfg, "kv", "heads")(x_kv)
             k, v = jnp.split(kv, 2, axis=-1)
             q = _dense(cfg.d_model, cfg, "q", "heads")(x_q)
+
+        if decode:
+            # Incremental decoding: append this step's K/V (one position per
+            # call) to the cache and attend over everything written so far —
+            # O(1) projection work per generated token instead of
+            # re-projecting the whole prefix (the flax decode-cache pattern).
+            if x_kv is not None:
+                raise ValueError("decode=True applies to self-attention only")
+            is_initialized = self.has_variable("cache", "cached_key")
+            cached_k = self.variable(
+                "cache", "cached_key",
+                jnp.zeros, (b, cfg.max_len, cfg.d_model), k.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_value",
+                jnp.zeros, (b, cfg.max_len, cfg.d_model), v.dtype,
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if not is_initialized:
+                # Cache-shape init trace: K/V are this call's (length-1)
+                # projections; any caller-passed full-width validity mask
+                # does not apply to them.
+                kv_valid = None
+            else:
+                idx = cache_index.value
+                cached_k.value = jax.lax.dynamic_update_slice_in_dim(
+                    cached_k.value, k, idx, axis=1
+                )
+                cached_v.value = jax.lax.dynamic_update_slice_in_dim(
+                    cached_v.value, v, idx, axis=1
+                )
+                cache_index.value = idx + s_q
+                k, v = cached_k.value, cached_v.value
+                s_kv = cfg.max_len
+                # Only the filled prefix is attendable (causality within the
+                # written positions is implied by generation order); a
+                # caller-provided kv_valid further masks positions whose
+                # token is pad — matching the naive decoder's trg_valid.
+                prefix = jnp.broadcast_to(
+                    jnp.arange(cfg.max_len) < idx + s_q, (b, cfg.max_len)
+                )
+                kv_valid = prefix if kv_valid is None else prefix & kv_valid
+                causal = False
 
         # Structured (causal/kv_valid) masks stream through the Pallas flash
         # kernel on TPU; a dense mask falls back to the fused-XLA path.
@@ -236,6 +299,7 @@ class DecoderLayer(nn.Module):
         memory_valid=None,
         *,
         self_causal: bool = False,
+        decode: bool = False,
         deterministic: bool = True,
     ):
         drop = nn.Dropout(self.cfg.dropout, deterministic=deterministic)
@@ -244,6 +308,7 @@ class DecoderLayer(nn.Module):
             mask=self_mask,
             causal=self_causal,
             kv_valid=trg_valid,
+            decode=decode,
             deterministic=deterministic,
         )
         y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln1")(y + drop(attn))
@@ -273,10 +338,14 @@ class Decoder(nn.Module):
         memory_valid=None,
         *,
         self_causal: bool = False,
+        decode: bool = False,
+        position_offset: jnp.ndarray | int = 0,
         deterministic: bool = True,
     ):
         y = SentenceEmbedding(self.cfg.trg_vocab_size, self.cfg, name="embed")(
-            trg_tokens, deterministic=deterministic
+            trg_tokens,
+            deterministic=deterministic,
+            position_offset=position_offset,
         )
         for i in range(self.cfg.num_layers):
             y = DecoderLayer(self.cfg, name=f"layer_{i}")(
@@ -287,6 +356,7 @@ class Decoder(nn.Module):
                 trg_valid,
                 memory_valid,
                 self_causal=self_causal,
+                decode=decode,
                 deterministic=deterministic,
             )
         return y
@@ -376,6 +446,25 @@ class Transformer(nn.Module):
         )
         return self.lm_head(y)
 
+    def decode_step(self, token, memory, src_valid, position, trg_valid=None):
+        """One incremental step: ``token`` is ``[B, 1]``, self-attention
+        K/V come from the mutable ``cache`` collection — O(1) projection
+        work per generated token (the KV-cache decoder). ``trg_valid``
+        ([B, max_len]) marks which written cache positions hold real (non-
+        pad) tokens, mirroring the naive decoder's padding mask."""
+        y = self.decoder(
+            token,
+            memory,
+            None,
+            None,
+            trg_valid,
+            src_valid,
+            decode=True,
+            position_offset=position,
+            deterministic=True,
+        )
+        return self.lm_head(y)
+
 
 def greedy_translate(
     model: "Transformer",
@@ -402,7 +491,7 @@ def greedy_translate(
         max_new_tokens = cfg.max_len - 1
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    length = max_new_tokens + 1  # + the sos slot
+    length = max_new_tokens + 1  # + the sos slot; PE table grows statically
     src_valid = src_tokens != pad
     memory = model.apply(
         {"params": params}, src_tokens, method=Transformer.encode
@@ -428,4 +517,84 @@ def greedy_translate(
         return (ys, finished), None
 
     (ys, _), _ = jax.lax.scan(step, (ys, finished), jnp.arange(length - 1))
+    return ys
+
+
+def greedy_translate_cached(
+    model: "Transformer",
+    params,
+    src_tokens: jnp.ndarray,
+    *,
+    max_new_tokens: int | None = None,
+    sos_id: int = 1,
+    eos_id: int = 2,
+) -> jnp.ndarray:
+    """KV-cache greedy decoding: each step runs the decoder stack on only
+    the new token, appending its self-attention K/V to a mutable cache —
+    the O(L)-per-step full re-decode of ``greedy_translate`` (self QKV +
+    FFN over the whole prefix) drops to O(1). Cross-attention still
+    projects the encoder memory each step (same cost as the naive path;
+    caching memory K/V is the documented further optimization). Same
+    output contract as ``greedy_translate``.
+    """
+    cfg = model.cfg
+    pad = cfg.pad_id
+    if max_new_tokens is None:
+        max_new_tokens = cfg.max_len - 1
+    if not 1 <= max_new_tokens <= cfg.max_len - 1:
+        raise ValueError(
+            f"max_new_tokens must be in [1, {cfg.max_len - 1}], got "
+            f"{max_new_tokens}"
+        )
+    b = src_tokens.shape[0]
+    src_valid = src_tokens != pad
+    memory = model.apply(
+        {"params": params}, src_tokens, method=Transformer.encode
+    )
+    # Cache buffers sized to the generation length, not cfg.max_len — the
+    # params are max_len-independent, so a config-shrunk twin of the model
+    # right-sizes every layer's K/V cache (and each step's attention span).
+    gen_len = max_new_tokens + 1
+    decode_model = Transformer(dataclasses.replace(cfg, max_len=gen_len))
+    # Zeroed cache pytree via eval_shape: no throwaway forward pass compiled.
+    _, shapes = jax.eval_shape(
+        lambda: decode_model.apply(
+            {"params": params},
+            jnp.full((b, 1), sos_id, jnp.int32),
+            memory,
+            src_valid,
+            jnp.zeros((), jnp.int32),
+            jnp.ones((b, gen_len), bool),
+            method=Transformer.decode_step,
+            mutable=["cache"],
+        )
+    )
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+    ys = jnp.full((b, gen_len), pad, jnp.int32)
+    ys = ys.at[:, 0].set(sos_id)
+    finished = jnp.zeros(b, bool)
+
+    def step(carry, t):
+        ys, finished, cache = carry
+        token = jax.lax.dynamic_slice_in_dim(ys, t, 1, axis=1)
+        logits, updated = decode_model.apply(
+            {"params": params, "cache": cache},
+            token,
+            memory,
+            src_valid,
+            t,
+            ys != pad,  # pad tokens in the prefix stay unattendable (naive parity)
+            method=Transformer.decode_step,
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, pad, nxt)
+        finished = finished | (nxt == eos_id)
+        ys = jax.lax.dynamic_update_index_in_dim(ys, nxt, t + 1, axis=1)
+        return (ys, finished, updated["cache"]), None
+
+    (ys, _, _), _ = jax.lax.scan(
+        step, (ys, finished, cache), jnp.arange(max_new_tokens)
+    )
     return ys

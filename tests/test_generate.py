@@ -21,6 +21,7 @@ from machine_learning_apache_spark_tpu.models import (
     Transformer,
     TransformerConfig,
     greedy_translate,
+    greedy_translate_cached,
 )
 
 
@@ -138,3 +139,37 @@ class TestLearnsToTranslate:
         real = target != PAD_ID
         acc = (pred[real] == target[real]).mean()
         assert acc > 0.5, f"decode accuracy {acc:.2f} — model did not learn"
+
+        # The KV-cache decoder must reproduce the naive decoder exactly on
+        # a trained (non-degenerate) model.
+        cached = np.asarray(
+            greedy_translate_cached(
+                model, result.state.params, held_src, max_new_tokens=9
+            )
+        )
+        np.testing.assert_array_equal(cached, decoded)
+
+
+class TestCachedDecoder:
+    def test_matches_naive_random_params(self):
+        model = tiny_model(max_len=16)
+        src = jnp.asarray(
+            np.random.default_rng(3).integers(4, 60, (3, 10)), jnp.int32
+        )
+        params = model.init(
+            jax.random.key(1), src, jnp.ones((3, 8), jnp.int32)
+        )["params"]
+        naive = greedy_translate(model, params, src, max_new_tokens=12)
+        cached = greedy_translate_cached(model, params, src, max_new_tokens=12)
+        np.testing.assert_array_equal(np.asarray(cached), np.asarray(naive))
+
+    def test_bounds_validated(self):
+        model = tiny_model(max_len=8)
+        src = jnp.full((1, 4), 5, jnp.int32)
+        params = model.init(
+            jax.random.key(0), src, jnp.full((1, 4), 6, jnp.int32)
+        )["params"]
+        import pytest
+
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            greedy_translate_cached(model, params, src, max_new_tokens=8)
