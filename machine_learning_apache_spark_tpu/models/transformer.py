@@ -146,19 +146,35 @@ class MultiHeadAttention(nn.Module):
             qkv = _dense(3 * cfg.d_model, cfg, "qkv", "heads")(x_q)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             s_kv = s_q
+        elif decode:
+            # Cross-attention decode: the encoder memory is fixed for the
+            # whole generation, so its K/V projection is done once — on the
+            # cache-priming call — and reused from the cache every step
+            # (one [S_src, d]×[d, 2d] matmul per sequence, not per token).
+            s_kv = x_kv.shape[1]
+            q = _dense(cfg.d_model, cfg, "q", "heads")(x_q)
+            if not self.has_variable("cache", "cached_mem_key"):
+                kv = _dense(2 * cfg.d_model, cfg, "kv", "heads")(x_kv)
+                k, v = jnp.split(kv, 2, axis=-1)
+                self.variable("cache", "cached_mem_key", lambda: k)
+                self.variable("cache", "cached_mem_value", lambda: v)
+            else:
+                # The "kv" Dense is skipped entirely on cached steps; all
+                # submodules here carry explicit names so the module tree
+                # stays stable regardless.
+                k = self.variable("cache", "cached_mem_key", None).value
+                v = self.variable("cache", "cached_mem_value", None).value
         else:
             s_kv = x_kv.shape[1]
             kv = _dense(2 * cfg.d_model, cfg, "kv", "heads")(x_kv)
             k, v = jnp.split(kv, 2, axis=-1)
             q = _dense(cfg.d_model, cfg, "q", "heads")(x_q)
 
-        if decode:
+        if decode and x_kv is None:
             # Incremental decoding: append this step's K/V (one position per
             # call) to the cache and attend over everything written so far —
             # O(1) projection work per generated token instead of
             # re-projecting the whole prefix (the flax decode-cache pattern).
-            if x_kv is not None:
-                raise ValueError("decode=True applies to self-attention only")
             is_initialized = self.has_variable("cache", "cached_key")
             cached_k = self.variable(
                 "cache", "cached_key",
@@ -317,6 +333,7 @@ class DecoderLayer(nn.Module):
             memory,
             mask=cross_mask,
             kv_valid=memory_valid,
+            decode=decode,
             deterministic=deterministic,
         )
         y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln2")(y + drop(cross))
@@ -532,10 +549,10 @@ def greedy_translate_cached(
     """KV-cache greedy decoding: each step runs the decoder stack on only
     the new token, appending its self-attention K/V to a mutable cache —
     the O(L)-per-step full re-decode of ``greedy_translate`` (self QKV +
-    FFN over the whole prefix) drops to O(1). Cross-attention still
-    projects the encoder memory each step (same cost as the naive path;
-    caching memory K/V is the documented further optimization). Same
-    output contract as ``greedy_translate``.
+    FFN over the whole prefix) drops to O(1). Cross-attention K/V over the
+    encoder memory are projected once, on the cache-priming call, and
+    reused from the cache every step. Same output contract as
+    ``greedy_translate``.
     """
     cfg = model.cfg
     pad = cfg.pad_id
@@ -556,20 +573,23 @@ def greedy_translate_cached(
     # right-sizes every layer's K/V cache (and each step's attention span).
     gen_len = max_new_tokens + 1
     decode_model = Transformer(dataclasses.replace(cfg, max_len=gen_len))
-    # Zeroed cache pytree via eval_shape: no throwaway forward pass compiled.
-    _, shapes = jax.eval_shape(
-        lambda: decode_model.apply(
-            {"params": params},
-            jnp.full((b, 1), sos_id, jnp.int32),
-            memory,
-            src_valid,
-            jnp.zeros((), jnp.int32),
-            jnp.ones((b, gen_len), bool),
-            method=Transformer.decode_step,
-            mutable=["cache"],
-        )
+    # Cache-priming call: creates the (zeroed) self-attention K/V buffers AND
+    # projects the encoder memory's cross-attention K/V once, storing them in
+    # the cache — every scanned step below reuses them without touching the
+    # "kv" projection again. The priming logits are discarded; the scan's
+    # t=0 step recomputes sos with identical semantics (the init trace writes
+    # nothing into the self-attention cache).
+    _, primed = decode_model.apply(
+        {"params": params},
+        jnp.full((b, 1), sos_id, jnp.int32),
+        memory,
+        src_valid,
+        jnp.zeros((), jnp.int32),
+        jnp.ones((b, gen_len), bool),
+        method=Transformer.decode_step,
+        mutable=["cache"],
     )
-    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+    cache = primed["cache"]
 
     ys = jnp.full((b, gen_len), pad, jnp.int32)
     ys = ys.at[:, 0].set(sos_id)

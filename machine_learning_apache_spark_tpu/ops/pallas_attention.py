@@ -39,6 +39,7 @@ def _flash_kernel(
     v_ref,
     *refs,
     has_kv_valid: bool,
+    return_lse: bool,
     causal: bool,
     causal_offset: int,
     kv_len: int,
@@ -48,12 +49,14 @@ def _flash_kernel(
     scale: float,
 ):
     # The kv_valid operand exists only when a mask was passed — the unmasked
-    # hot path pays no extra HBM traffic or per-tile AND.
-    if has_kv_valid:
-        kv_valid_ref, o_ref, m_scr, l_scr, acc_scr = refs
-    else:
-        kv_valid_ref = None
-        o_ref, m_scr, l_scr, acc_scr = refs
+    # hot path pays no extra HBM traffic or per-tile AND. The lse output
+    # exists only under differentiation (the backward kernels recompute
+    # probabilities from it instead of saving the [S, S] matrix).
+    refs = list(refs)
+    kv_valid_ref = refs.pop(0) if has_kv_valid else None
+    o_ref = refs.pop(0)
+    lse_ref = refs.pop(0) if return_lse else None
+    m_scr, l_scr, acc_scr = refs
     i = pl.program_id(1)  # query-block index
     j = pl.program_id(2)  # key-block index (innermost, sequential)
 
@@ -117,6 +120,12 @@ def _flash_kernel(
         l = l_scr[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        if return_lse:
+            # Row softmax normalizer in log space; NEG_INF marks fully-masked
+            # rows so the backward masks them out entirely.
+            lse_ref[0] = jnp.where(
+                l == 0.0, NEG_INF, m_scr[:] + jnp.log(safe_l)
+            )[:, 0]
 
 
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
@@ -153,11 +162,13 @@ def flash_attention(
     padding-mask case of the MT model (``make_padding_mask`` semantics),
     streamed through the kernel instead of materializing ``[B, Sq, Sk]``.
 
-    Differentiable: the forward pass streams through the kernel; the
-    backward recomputes attention on the fused-XLA path (a dedicated Pallas
-    backward kernel is the documented follow-up — for long-context
-    *training* memory the sequence-sharded ``parallel.ring_attention`` is
-    the intended path).
+    Differentiable end to end: the forward streams through the kernel and
+    saves per-row log-sum-exp statistics; the backward recomputes block
+    probabilities from them in two more Pallas launches (flash-2 style dq
+    and dk/dv kernels) — O(S) memory in both directions, which is what makes
+    long-context *training* affordable. Below ``PALLAS_BWD_MIN_SCORES``
+    score elements the backward falls back to the fused-XLA dense recompute
+    (cheaper than two kernel launches at short sequence lengths).
     """
     cfg = (causal, block_q, block_k, interpret)
     if kv_valid is None:
@@ -176,17 +187,38 @@ def _dense_reference(query, key, value, causal, kv_valid):
     )
 
 
+# Below this many score-matrix elements the fused-XLA dense recompute is
+# both affordable and faster than a second kernel launch pair; above it the
+# blockwise backward avoids materializing [S_q, S_k] chains entirely (the
+# long-context training seam).
+PALLAS_BWD_MIN_SCORES = 256 * 1024
+
+
+def _use_pallas_bwd(q_len: int, kv_len: int) -> bool:
+    return q_len * kv_len >= PALLAS_BWD_MIN_SCORES
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _flash_vjp_nomask(cfg, query, key, value):
     return _flash_forward(query, key, value, None, *cfg)
 
 
 def _flash_nomask_fwd(cfg, query, key, value):
-    return _flash_vjp_nomask(cfg, query, key, value), (query, key, value)
+    # The out/lse residuals are only kept when the pallas backward will read
+    # them (shape-static decision); the short-sequence dense fallback keeps
+    # the lean (q, k, v) residuals and skips the lse output entirely.
+    if _use_pallas_bwd(query.shape[2], key.shape[2]):
+        out, lse = _flash_forward(
+            query, key, value, None, *cfg, return_lse=True
+        )
+        return out, (query, key, value, out, lse)
+    return _flash_vjp_nomask(cfg, query, key, value), (query, key, value, None, None)
 
 
 def _flash_nomask_bwd(cfg, res, g):
-    query, key, value = res
+    query, key, value, out, lse = res
+    if _use_pallas_bwd(query.shape[2], key.shape[2]):
+        return _flash_backward(cfg, query, key, value, None, out, lse, g)
     _, vjp = jax.vjp(
         lambda q, k, v: _dense_reference(q, k, v, cfg[0], None),
         query, key, value,
@@ -203,12 +235,24 @@ def _flash_vjp_masked(cfg, query, key, value, kv_valid):
 
 
 def _flash_masked_fwd(cfg, query, key, value, kv_valid):
-    out = _flash_vjp_masked(cfg, query, key, value, kv_valid)
-    return out, (query, key, value, kv_valid)
+    if _use_pallas_bwd(query.shape[2], key.shape[2]):
+        out, lse = _flash_forward(
+            query, key, value, kv_valid, *cfg, return_lse=True
+        )
+        return out, (query, key, value, kv_valid, out, lse)
+    return (
+        _flash_vjp_masked(cfg, query, key, value, kv_valid),
+        (query, key, value, kv_valid, None, None),
+    )
 
 
 def _flash_masked_bwd(cfg, res, g):
-    query, key, value, kv_valid = res
+    query, key, value, kv_valid, out, lse = res
+    if _use_pallas_bwd(query.shape[2], key.shape[2]):
+        return (
+            *_flash_backward(cfg, query, key, value, kv_valid, out, lse, g),
+            None,
+        )
     _, vjp = jax.vjp(
         lambda q, k, v: _dense_reference(q, k, v, cfg[0], kv_valid),
         query, key, value,
@@ -219,15 +263,283 @@ def _flash_masked_bwd(cfg, res, g):
 _flash_vjp_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
 
 
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+    has_kv_valid: bool, causal: bool, causal_offset: int,
+    q_len: int, kv_len: int, block_q: int, block_k: int,
+    num_k_blocks: int, scale: float,
+):
+    """dQ = Σ_j dS_ij @ K_j, streaming K/V blocks (flash-2 backward, q side).
+
+    Probabilities are recomputed per block from the saved row normalizer
+    (``lse``) — no [S_q, S_k] tensor is ever read or written.
+    """
+    if has_kv_valid:
+        kv_valid_ref, dq_ref, dq_scr = refs
+    else:
+        kv_valid_ref = None
+        dq_ref, dq_scr = refs
+    i = pl.program_id(1)  # query block
+    j = pl.program_id(2)  # key block (innermost, sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = (
+        (j * block_k <= i * block_q + block_q - 1 + causal_offset)
+        if causal
+        else True
+    )
+
+    @pl.when(needed)
+    def _block():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0]      # [block_q, 1]
+        delta = delta_ref[0]  # [block_q, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        k_idx = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        q_idx = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        mask = (k_idx < kv_len) & (q_idx < q_len)
+        if has_kv_valid:
+            mask = mask & (kv_valid_ref[0] != 0)
+        if causal:
+            mask = mask & (k_idx <= q_idx + causal_offset)
+        # Fully-masked rows carry lse == NEG_INF; exp would overflow to inf
+        # before the where, so gate on a finite normalizer too.
+        mask = mask & (lse > NEG_INF * 0.5)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+    has_kv_valid: bool, causal: bool, causal_offset: int,
+    q_len: int, kv_len: int, block_q: int, block_k: int,
+    num_q_blocks: int, scale: float,
+):
+    """dK_j = Σ_i dSᵀ_ij @ Q_i, dV_j = Σ_i Pᵀ_ij @ dO_i — the k/v side,
+    streaming Q/dO blocks with scores computed transposed ([block_k,
+    block_q]) so both accumulators live in k-block scratch."""
+    if has_kv_valid:
+        kv_valid_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        kv_valid_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
+    j = pl.program_id(1)  # key block
+    i = pl.program_id(2)  # query block (innermost, sequential)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = (
+        (j * block_k <= i * block_q + block_q - 1 + causal_offset)
+        if causal
+        else True
+    )
+
+    @pl.when(needed)
+    def _block():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0]      # [1, block_q] (row layout over q columns)
+        delta = delta_ref[0]  # [1, block_q]
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        k_idx = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0
+        )
+        q_idx = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1
+        )
+        mask = (k_idx < kv_len) & (q_idx < q_len)
+        if has_kv_valid:
+            mask = mask & (kv_valid_ref[0] != 0)  # [block_k, 1] column layout
+        if causal:
+            mask = mask & (k_idx <= q_idx + causal_offset)
+        mask = mask & (lse > NEG_INF * 0.5)
+        p_t = jnp.where(mask, jnp.exp(s_t - lse), 0.0)
+        dv_scr[:] += jax.lax.dot_general(
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds_t = p_t * (dp_t - delta)
+        dk_scr[:] += jax.lax.dot_general(
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(cfg, query, key, value, kv_valid, out, lse, g):
+    """Blockwise dq/dk/dv (flash-2): two kernel launches, O(S) memory.
+
+    ``lse`` arrives [B*H, q_pad] from the forward (same block clamping, so
+    the padded length matches); ``delta = rowsum(dO ∘ O)`` is a cheap fused
+    XLA reduction computed here, not a kernel.
+    """
+    causal, block_q, block_k, interpret = cfg
+    b, h, q_len, d = query.shape
+    kv_len = key.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    block_q, block_k = _block_sizes(q_len, kv_len, block_q, block_k)
+
+    q = _pad_to(_pad_to(query, 2, block_q), 3, 128)
+    k = _pad_to(_pad_to(key, 2, block_k), 3, 128)
+    v = _pad_to(_pad_to(value, 2, block_k), 3, 128)
+    do = _pad_to(_pad_to(g, 2, block_q), 3, 128).astype(query.dtype)
+    d_pad = q.shape[3]
+    q_pad, k_pad = q.shape[2], k.shape[2]
+    bh = b * h
+    q = q.reshape(bh, q_pad, d_pad)
+    k = k.reshape(bh, k_pad, d_pad)
+    v = v.reshape(bh, k_pad, d_pad)
+    do = do.reshape(bh, q_pad, d_pad)
+    num_q_blocks = q_pad // block_q
+    num_k_blocks = k_pad // block_k
+
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(bh, q_len)
+    delta = _pad_to(delta, 1, block_q)
+
+    # Column ([.., q_pad, 1]) and row ([.., 1, q_pad]) layouts of the per-row
+    # statistics: the dq kernel broadcasts them down k columns, the dkv
+    # kernel across q columns — Mosaic-friendly 2D blocks either way.
+    lse_col, delta_col = lse[:, :, None], delta[:, :, None]
+    lse_row, delta_row = lse[:, None, :], delta[:, None, :]
+
+    common = dict(
+        causal=causal,
+        causal_offset=kv_len - q_len,
+        q_len=q_len,
+        kv_len=kv_len,
+        block_q=block_q,
+        block_k=block_k,
+        scale=scale,
+        has_kv_valid=kv_valid is not None,
+    )
+    qkvdo_specs = [
+        pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+    ]
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
+
+    dq_operands = [q, k, v, do, lse_col, delta_col]
+    dq_specs = [
+        *qkvdo_specs,
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    if kv_valid is not None:
+        valid = _pad_to(kv_valid.astype(jnp.int32), 1, block_k)
+        dq_operands.append(valid[:, None, :])
+        dq_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j, h=h: (b // h, 0, j))
+        )
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, num_k_blocks=num_k_blocks, **common
+        ),
+        grid=(bh, num_q_blocks, num_k_blocks),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_pad, d_pad), query.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(*dq_operands)
+
+    # dkv grid: key blocks in the middle (parallel), query blocks innermost
+    # (sequential) so the dk/dv accumulators persist across the q sweep.
+    dkv_operands = [q, k, v, do, lse_row, delta_row]
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d_pad), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d_pad), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+    ]
+    if kv_valid is not None:
+        dkv_operands.append(valid[:, :, None])
+        dkv_specs.append(
+            pl.BlockSpec((1, block_k, 1), lambda b, j, i, h=h: (b // h, j, 0))
+        )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, num_q_blocks=num_q_blocks, **common
+        ),
+        grid=(bh, num_k_blocks, num_q_blocks),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, k_pad, d_pad), key.dtype),
+            jax.ShapeDtypeStruct((bh, k_pad, d_pad), value.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(*dkv_operands)
+
+    dq = dq.reshape(b, h, q_pad, d_pad)[:, :, :q_len, :d]
+    dk = dk.reshape(b, h, k_pad, d_pad)[:, :, :kv_len, :d]
+    dv = dv.reshape(b, h, k_pad, d_pad)[:, :, :kv_len, :d]
+    return dq, dk, dv
+
+
+def _block_sizes(q_len: int, kv_len: int, block_q: int, block_k: int):
+    return (
+        min(block_q, max(8, -(-q_len // 8) * 8)),
+        min(block_k, max(128, -(-kv_len // 128) * 128)),
+    )
+
+
 def _flash_forward(
-    query, key, value, kv_valid, causal, block_q, block_k, interpret
+    query, key, value, kv_valid, causal, block_q, block_k, interpret,
+    return_lse: bool = False,
 ):
     b, h, q_len, d = query.shape
     kv_len = key.shape[2]
     scale = 1.0 / math.sqrt(d)
 
-    block_q = min(block_q, max(8, -(-q_len // 8) * 8))
-    block_k = min(block_k, max(128, -(-kv_len // 128) * 128))
+    block_q, block_k = _block_sizes(q_len, kv_len, block_q, block_k)
 
     q = _pad_to(_pad_to(query, 2, block_q), 3, 128)
     k = _pad_to(_pad_to(key, 2, block_k), 3, 128)
@@ -264,6 +576,7 @@ def _flash_forward(
     kernel = functools.partial(
         _flash_kernel,
         has_kv_valid=kv_valid is not None,
+        return_lse=return_lse,
         causal=causal,
         causal_offset=kv_len - q_len,
         kv_len=kv_len,
@@ -272,7 +585,12 @@ def _flash_forward(
         num_k_blocks=num_k_blocks,
         scale=scale,
     )
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, q_pad, d_pad), query.dtype)]
+    if return_lse:
+        out_specs.append(pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, q_pad), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=(bh, num_q_blocks, num_k_blocks),
         in_specs=[
@@ -281,8 +599,8 @@ def _flash_forward(
             pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
             *valid_specs,
         ],
-        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, q_pad, d_pad), query.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -294,4 +612,7 @@ def _flash_forward(
         interpret=interpret,
     )(*operands)
 
-    return out.reshape(b, h, q_pad, d_pad)[:, :, :q_len, :d]
+    out = res[0].reshape(b, h, q_pad, d_pad)[:, :, :q_len, :d]
+    if return_lse:
+        return out, res[1]  # lse stays [B*H, q_pad] for the backward kernels
+    return out

@@ -76,7 +76,9 @@ def make_loaders(
     - single-process multi-device: one loader at ``batch_size × data_axis``
       and the mesh splits it — same per-replica batch, no sampler needed.
 
-    ``drop_last=True`` everywhere: one static shape, one XLA program.
+    ``drop_last=True`` on the train loader (one static shape, one XLA
+    program); the test loader keeps its ragged tail so eval scores every row
+    (see ``train.loop.evaluate``).
     """
     world = jax.process_count()
     data_size = mesh.shape[DATA_AXIS] if mesh is not None else 1
@@ -123,11 +125,15 @@ def make_loaders(
             else None
         )
         n_test = len(test_sampler) if test_sampler is not None else len(test_ds)
+        # drop_last=False: the reference's eval consumes the ENTIRE test
+        # loader (``pytorch_cnn.py:154-176``); silently skipping up to
+        # batch-1 rows would misreport accuracy. The ragged tail batch costs
+        # one extra XLA compile and is run unsharded (see train.loop.evaluate).
         test_loader = DataLoader(
             test_ds,
             _clamped(n_test, batch_size * local_scale, "test"),
             sampler=test_sampler,
-            drop_last=True,
+            drop_last=False,
             seed=seed,
             collate=collate,
         )

@@ -37,6 +37,7 @@ class CheckpointManager:
 
     def __init__(self, directory: str, *, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
+        self._last_saved: int | None = None
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -47,6 +48,18 @@ class CheckpointManager:
     # -- write ---------------------------------------------------------------
     def save(self, state: TrainState, *, step: int | None = None, wait: bool = True) -> int:
         step = int(state.step if step is None else step)
+        # Saving the same step twice WITHIN this run (e.g. a zero-batch epoch
+        # leaves state.step unchanged, then the epoch-end hook fires again)
+        # is a no-op. A step left on disk by a PRIOR run is different — after
+        # a restore-and-retrain the new trajectory must win, so it is
+        # deleted and rewritten, never silently skipped.
+        if step == self._last_saved:
+            log.info("checkpoint step %d already saved this run; skipping", step)
+            return step
+        if step in self._mgr.all_steps():
+            log.info("overwriting stale checkpoint step %d from a prior run", step)
+            self._mgr.delete(step)
+        self._last_saved = step
         payload = {
             "step": jax.device_get(state.step),
             "params": state.params,

@@ -192,21 +192,49 @@ def evaluate(
     """Eval pass: accumulated loss + metrics — the reference's
     ``model.eval()`` + ``no_grad`` + accuracy block
     (``pytorch_cnn.py:154-176``). Deterministic (loss_fn receives a fixed
-    key; dropout layers must run deterministic under it)."""
+    key; dropout layers must run deterministic under it).
+
+    Consumes the WHOLE loader, matching the reference: a ragged tail batch
+    (``drop_last=False`` loaders) that does not divide the mesh's data axis
+    runs unsharded on the default device — one extra compile, zero skipped
+    rows. Per-batch metrics are weighted by the real row count, and the
+    total is returned as ``eval_samples`` so callers can assert full
+    coverage. Exception: under a multi-process gang a ragged local tail
+    cannot be assembled into a global array for the sharded step, so it is
+    skipped with a warning (the single-controller boundary; every
+    single-process path keeps full coverage).
+    """
+    from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+
     emit = emit or log.info
     rng = rng if rng is not None else jax.random.key(0)
     step_fn = make_eval_step(loss_fn)
     metrics = MetricBundle()
+    # Divisibility is judged against the LOCAL device count: each process
+    # contributes its local rows (shard_batch assembles the global array).
+    local_size = (
+        mesh.shape[DATA_AXIS] // jax.process_count() if mesh is not None else 1
+    )
+    total = 0
     for batch in eval_loader:
-        if mesh is not None:
-            batch = shard_batch(mesh, batch)
-        loss, aux = step_fn(state, batch, rng)
         n = len(jax.tree.leaves(batch)[0])
+        if mesh is not None and n % local_size == 0:
+            batch = shard_batch(mesh, batch)
+        elif mesh is not None and jax.process_count() > 1:
+            log.warning(
+                "skipping %d-row ragged eval tail: a process-local tail "
+                "cannot join the sharded step (%d local devices)",
+                n, local_size,
+            )
+            continue
+        loss, aux = step_fn(state, batch, rng)
+        total += n
         metrics.mean("test_loss").update(loss, n)
         for k, v in aux.items():
             metrics.mean(k).update(v, n)
     out = metrics.compute()
     emit(" | ".join(f"{k}: {v:.5f}" for k, v in out.items()))
+    out["eval_samples"] = total
     return out
 
 

@@ -50,6 +50,46 @@ class TestCheckpointManager:
             _, step = ckpt.restore(make_state())
             assert step == 3
 
+    def test_duplicate_step_save_is_noop(self, tmp_path):
+        """A zero-batch epoch leaves state.step unchanged; the epoch-end
+        save hook firing again must skip, not crash mid-training."""
+        state = make_state()
+        with CheckpointManager(str(tmp_path / "dup")) as ckpt:
+            ckpt.save(state, step=4)
+            assert ckpt.save(state, step=4) == 4  # no orbax duplicate error
+            assert ckpt.all_steps() == [4]
+
+    def test_prior_run_step_is_overwritten(self, tmp_path):
+        """After restore-and-retrain, the NEW trajectory must win at step
+        numbers a previous run already wrote — overwrite, never skip."""
+        state_a = make_state(seed=0)
+        with CheckpointManager(str(tmp_path / "o")) as ckpt:
+            ckpt.save(state_a, step=2)
+        state_b = make_state(seed=7)
+        with CheckpointManager(str(tmp_path / "o")) as ckpt:
+            ckpt.save(state_b, step=2)
+            restored, _ = ckpt.restore(make_state(seed=1))
+        assert_trees_equal(restored.params, state_b.params)
+
+    def test_fit_with_empty_epochs_does_not_crash(self, tmp_path):
+        from machine_learning_apache_spark_tpu.train.loop import (
+            classification_loss,
+            fit,
+        )
+
+        state = make_state()
+        with CheckpointManager(str(tmp_path / "empty_fit")) as ckpt:
+            fit(
+                state,
+                classification_loss(state.apply_fn),
+                [],  # zero batches per epoch: step never advances
+                epochs=3,
+                log_every=0,
+                checkpointer=ckpt,
+                checkpoint_every=1,
+            )
+            assert ckpt.all_steps() == [0]
+
     def test_restore_empty_raises(self, tmp_path):
         with CheckpointManager(str(tmp_path / "empty")) as ckpt:
             with pytest.raises(FileNotFoundError):

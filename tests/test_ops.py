@@ -232,3 +232,83 @@ class TestFlashAttention:
         expected = scaled_dot_product_attention(q, k, v, make_causal_mask(300))
         got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128, interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-3)
+
+
+class TestFlashBackward:
+    """The Pallas flash-2 backward (blockwise dq/dk/dv from saved lse):
+    grads must match the dense XLA path on shapes above the pallas-backward
+    threshold, across structured-mask configurations."""
+
+    SHAPE = (1, 2, 512, 32)  # 512×512 scores ≥ PALLAS_BWD_MIN_SCORES
+
+    def _grads(self, fn, *args):
+        return jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v) ** 2), argnums=(0, 1, 2)
+        )(*args)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("use_valid", [False, True])
+    def test_grads_match_dense(self, rng, causal, use_valid):
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            dot_product_attention,
+        )
+        from machine_learning_apache_spark_tpu.ops.pallas_attention import (
+            _use_pallas_bwd,
+        )
+
+        b, h, s, d = self.SHAPE
+        assert _use_pallas_bwd(s, s), "shape must exercise the pallas backward"
+        q = jnp.asarray(rng.standard_normal((b, h, s, d)), dtype=jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, h, s, d)), dtype=jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, h, s, d)), dtype=jnp.float32)
+        kv_valid = (
+            jnp.asarray(rng.random((b, s)) < 0.8) if use_valid else None
+        )
+        flash = self._grads(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, kv_valid=kv_valid, interpret=True
+            ),
+            q, k, v,
+        )
+        dense = self._grads(
+            lambda q, k, v: dot_product_attention(
+                q, k, v, causal=causal, kv_valid=kv_valid, use_pallas=False
+            ),
+            q, k, v,
+        )
+        for name, a, e in zip("qkv", flash, dense):
+            scale = float(jnp.max(jnp.abs(e))) + 1e-9
+            err = float(jnp.max(jnp.abs(a - e))) / scale
+            assert err < 1e-4, f"d{name} relative error {err}"
+
+    def test_masked_key_grads_are_zero(self, rng):
+        """dk/dv at kv_valid=False positions must be exactly zero — the
+        output doesn't depend on masked keys, so neither may the grads."""
+        b, h, s, d = self.SHAPE
+        q = jnp.asarray(rng.standard_normal((b, h, s, d)), dtype=jnp.float32)
+        kv_valid = jnp.arange(s)[None, :] < (s // 2)
+        kv_valid = jnp.broadcast_to(kv_valid, (b, s))
+        _, dk, dv = self._grads(
+            lambda q, k, v: flash_attention(
+                q, k, v, kv_valid=kv_valid, interpret=True
+            ),
+            q, q * 0.9, q * 1.1,
+        )
+        np.testing.assert_array_equal(np.asarray(dk)[:, :, s // 2 :], 0.0)
+        np.testing.assert_array_equal(np.asarray(dv)[:, :, s // 2 :], 0.0)
+
+    def test_small_shapes_use_dense_fallback(self, rng):
+        """Below the threshold the dense recompute path must stay exact."""
+        q = jnp.asarray(rng.standard_normal((1, 2, 64, 16)), dtype=jnp.float32)
+        flash = self._grads(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True),
+            q, q + 0.1, q - 0.1,
+        )
+        dense = self._grads(
+            lambda q, k, v: scaled_dot_product_attention(
+                q, k, v, make_causal_mask(64)
+            ),
+            q, q + 0.1, q - 0.1,
+        )
+        for a, e in zip(flash, dense):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e), atol=1e-4)

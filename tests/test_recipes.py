@@ -34,6 +34,13 @@ class TestCNNRecipe:
         assert out["history"][-1]["loss"] < out["history"][0]["loss"]
         assert "test_loss" in out and "accuracy" in out
 
+    def test_eval_consumes_full_test_set(self):
+        # synthetic_n=600 → 150 test rows; batch 128 leaves a 22-row ragged
+        # tail that does not divide the 8-device mesh — it must be scored
+        # anyway (the reference evals the whole loader, pytorch_cnn.py:154).
+        out = train_cnn(epochs=1, synthetic_n=600, batch_size=16)
+        assert out["eval_samples"] == 150
+
 
 class TestLSTMRecipe:
     def test_loss_decreases(self):

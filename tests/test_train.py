@@ -85,6 +85,27 @@ class TestFitMLP:
         assert metrics["accuracy"] > 80.0
         assert result.train_seconds > 0
 
+    def test_evaluate_consumes_every_sample(self, rng):
+        """Full-test-set eval (``pytorch_cnn.py:154-176`` consumes the whole
+        loader): a ragged tail batch that doesn't divide the mesh's data
+        axis must still be scored — unsharded — not silently dropped."""
+        from machine_learning_apache_spark_tpu.parallel import make_mesh
+        from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+
+        feats, labels = _synthetic_classification(rng, n=37)
+        model = MLP(layers=(4, 5, 4, 3))
+        params = model.init(jax.random.key(0), feats[:1])["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.03)
+        )
+        mesh = make_mesh({DATA_AXIS: 8})
+        batches = _batches(feats, labels, 16)  # 16, 16, 5 — ragged tail
+        metrics = evaluate(
+            state, classification_loss(model.apply, train=False), batches,
+            mesh=mesh, emit=lambda s: None,
+        )
+        assert metrics["eval_samples"] == 37
+
     def test_step_counter_advances(self, rng):
         feats, labels = _synthetic_classification(rng, n=30)
         model = MLP(layers=(4, 5, 4, 3))
