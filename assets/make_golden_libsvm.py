@@ -1,0 +1,51 @@
+"""Regenerate the golden 150-row libsvm sample (deterministic).
+
+The reference's C1/C3 data contract is Spark's
+``sample_multiclass_classification_data.txt`` — 150 rows, 4 features scaled
+to [-1, 1]-ish, 3 classes, libsvm format
+(``mllib_multilayer_perceptron_classifier.py:22-23``,
+``pytorch_multilayer_perceptron.py:56-66``). That file is iris rescaled;
+this stand-in has the same shape/format/separability: three Gaussian blobs
+(50 rows each, interleaved) clipped to [-1, 1], features rounded to 6
+decimals so the file is byte-stable.
+
+    python assets/make_golden_libsvm.py   # rewrites the .txt in place
+"""
+
+import os
+
+import numpy as np
+
+CENTERS = np.array(
+    [
+        [-0.6, -0.5, 0.5, 0.4],
+        [0.0, 0.6, -0.4, -0.6],
+        [0.6, -0.4, -0.5, 0.6],
+    ]
+)
+N_PER_CLASS = 50
+SCALE = 0.18
+
+
+def main() -> str:
+    rng = np.random.default_rng(1234)
+    rows = []
+    # Interleave classes (the Spark sample is not class-sorted either) so
+    # any prefix split keeps all three classes represented.
+    for i in range(N_PER_CLASS):
+        for label in range(3):
+            feats = CENTERS[label] + rng.normal(0, SCALE, 4)
+            feats = np.clip(np.round(feats, 6), -1.0, 1.0)
+            cols = " ".join(f"{j + 1}:{v:.6f}" for j, v in enumerate(feats))
+            rows.append(f"{label}.0 {cols}")
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "sample_multiclass_classification_data.txt",
+    )
+    with open(path, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    return path
+
+
+if __name__ == "__main__":
+    print(main())

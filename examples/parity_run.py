@@ -1,0 +1,79 @@
+"""Engine-parity run on the golden libsvm sample — C1 + C3 on real file data.
+
+The reference trains both its MLlib estimator
+(``mllib_multilayer_perceptron_classifier.py:22-48``) and its sequential
+torch MLP (``pytorch_multilayer_perceptron.py:56-146``) on the SAME 150-row
+4-feature/3-class libsvm file and prints accuracy + wall-time. This script
+is that contract against ``assets/sample_multiclass_classification_data.txt``
+(the checked-in regenerable stand-in): C1 via
+``MultilayerPerceptronClassifier`` (L-BFGS, 60/40 split seed 1234), C3 via
+the ``MLPRecipe`` (SGD 0.03, 100 epochs, batch 30, same split).
+
+    python examples/parity_run.py            # prints one JSON line
+    python examples/parity_run.py --cpu      # force the CPU backend
+
+Record the numbers in PARITY.md when they change materially.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+GOLDEN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "assets",
+    "sample_multiclass_classification_data.txt",
+)
+
+
+def run_c1() -> dict:
+    """MLlib path: estimator/transformer/evaluator on the golden file."""
+    from machine_learning_apache_spark_tpu.data import read_libsvm
+    from machine_learning_apache_spark_tpu.mllib import (
+        MulticlassClassificationEvaluator,
+        MultilayerPerceptronClassifier,
+    )
+
+    frame = read_libsvm(GOLDEN)
+    train, test = frame.random_split([0.6, 0.4], seed=1234)
+    trainer = MultilayerPerceptronClassifier(
+        layers=[4, 5, 4, 3], maxIter=100, blockSize=30, seed=1234
+    )
+    t0 = time.perf_counter()
+    model = trainer.fit(train)
+    fit_seconds = time.perf_counter() - t0
+    acc = MulticlassClassificationEvaluator("accuracy").evaluate(
+        model.transform(test)
+    )
+    return {
+        "accuracy": round(float(acc), 4),
+        "fit_seconds": round(fit_seconds, 3),
+        "rows": {"train": len(train.arrays()[1]), "test": len(test.arrays()[1])},
+    }
+
+
+def run_c3() -> dict:
+    """Sequential-MLP path: the torch-script workload as a recipe."""
+    from machine_learning_apache_spark_tpu.recipes import train_mlp
+
+    out = train_mlp(data_path=GOLDEN, log_every=0)
+    return {
+        "accuracy": round(out["accuracy"], 2),
+        "train_seconds": round(out["train_seconds"], 3),
+        "final_loss": round(out["final_loss"], 4),
+        "eval_samples": out.get("eval_samples"),
+    }
+
+
+if __name__ == "__main__":
+    result = {"golden_file": os.path.basename(GOLDEN),
+              "c1_mllib_lbfgs": run_c1(), "c3_seq_mlp": run_c3()}
+    print(json.dumps(result))

@@ -97,18 +97,60 @@ class MultilayerPerceptronClassifier:
             setattr(self, k, v)
         return self
 
-    def fit(self, frame: ArrayFrame) -> MultilayerPerceptronClassificationModel:
+    def fit(
+        self, frame: ArrayFrame, mesh=None
+    ) -> MultilayerPerceptronClassificationModel:
+        """Full-batch L-BFGS fit; ``mesh`` shards the batch over ``"data"``.
+
+        With a mesh, the per-iteration full-batch value+grad is computed
+        with features/labels sharded across the ``"data"`` axis and params
+        replicated — XLA's sharding propagation compiles the gradient
+        reduction into a psum over ICI, the treeAggregate of MLlib's engine
+        (``mllib_multilayer_perceptron_classifier.py:35-39`` via breeze
+        L-BFGS over an RDD). Rows are zero-weight-padded to divisibility, so
+        the sharded loss equals the single-device loss up to float32
+        reduction order; L-BFGS amplifies that ~1e-8 seed chaotically near
+        convergence, so final params are numerically equivalent, not
+        bit-identical (tests/test_mllib.py::TestMeshFit pins the bound).
+        """
         if self.solver.lower() not in ("l-bfgs", "lbfgs", "gd"):
             raise ValueError(f"unsupported solver {self.solver!r}")
         features, labels = frame.arrays()
         x = jnp.asarray(features, jnp.float32)
         y = jnp.asarray(labels)
+        n = x.shape[0]
+        weights = jnp.ones((n,), jnp.float32)
+
+        if mesh is not None:
+            from machine_learning_apache_spark_tpu.parallel.mesh import (
+                DATA_AXIS,
+                batch_sharding,
+            )
+
+            shards = mesh.shape[DATA_AXIS]
+            pad = (-n) % shards
+            if pad:
+                x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+                y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+                weights = jnp.concatenate([weights, jnp.zeros((pad,), jnp.float32)])
+            data_sharding = batch_sharding(mesh)
+            x = jax.device_put(x, data_sharding)
+            y = jax.device_put(y, data_sharding)
+            weights = jax.device_put(weights, data_sharding)
 
         mlp = MLP(layers=tuple(self.layers))
         params = mlp.init(jax.random.key(self.seed), x[:1])["params"]
+        if mesh is not None:
+            from machine_learning_apache_spark_tpu.parallel.mesh import replicate
+
+            params = replicate(mesh, params)
 
         def loss_fn(p):
-            return cross_entropy(mlp.apply({"params": p}, x), y)
+            # Weighted-mean CE: padding rows carry zero weight, so the
+            # sharded loss equals the unpadded single-device loss exactly.
+            logits = mlp.apply({"params": p}, x)
+            per_row = cross_entropy(logits, y, reduction="none")
+            return jnp.sum(per_row * weights) / jnp.sum(weights)
 
         if self.solver.lower() == "gd":
             # MLlib's alternative solver ('gd' stepSize semantics).

@@ -22,6 +22,7 @@ from machine_learning_apache_spark_tpu.ops.positional import sinusoidal_encoding
 from machine_learning_apache_spark_tpu.ops.attention import (
     scaled_dot_product_attention,
     multi_head_attention_weights,
+    sequence_parallel,
 )
 
 __all__ = [
@@ -32,4 +33,5 @@ __all__ = [
     "sinusoidal_encoding",
     "scaled_dot_product_attention",
     "multi_head_attention_weights",
+    "sequence_parallel",
 ]

@@ -23,10 +23,45 @@ under ``shard_map`` with K/V blocks rotating over ICI
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30  # finite -inf stand-in: keeps fully-masked rows NaN-free
+
+# Active sequence-parallel context (a stack so contexts nest): while set,
+# ``dot_product_attention`` routes self-attention through the ppermute ring
+# over the mesh's "seq" axis — the model code never changes (SURVEY.md §5
+# long-context seam).
+_SEQ_PARALLEL_CTX: list[tuple] = []
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh, *, seq_axis: str = "seq", batch_axis: str = "data"):
+    """Route zoo self-attention through ring attention on ``mesh``.
+
+    Usage (a dp×sp mesh; no model change):
+
+    >>> with sequence_parallel(mesh):
+    ...     result = fit(state, loss_fn, loader, mesh=mesh, ...)
+
+    Dispatch per attention site (see ``dot_product_attention``): structured-
+    mask self-attention whose sequence length divides the ``seq_axis`` size
+    goes through the ring; cross-attention, decode steps, and dense-mask
+    sites fall through to their usual paths.
+    """
+    if seq_axis not in mesh.shape:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no '{seq_axis}' axis")
+    _SEQ_PARALLEL_CTX.append((mesh, seq_axis, batch_axis))
+    try:
+        yield
+    finally:
+        _SEQ_PARALLEL_CTX.pop()
+
+
+def _active_seq_mesh():
+    return _SEQ_PARALLEL_CTX[-1] if _SEQ_PARALLEL_CTX else None
 
 
 def multi_head_attention_weights(
@@ -93,7 +128,34 @@ def dot_product_attention(
 
     ``use_pallas=None`` auto-selects the flash kernel on TPU whenever the
     mask is structured-only.
+
+    Under an active ``sequence_parallel(mesh)`` context, structured-mask
+    *self-attention* (Sq == Sk, divisible by the seq axis) dispatches to
+    ``parallel.ring_attention`` instead — K/V chunks rotate over ICI and no
+    device ever holds the full sequence. Other sites (cross-attention,
+    KV-cache decode, dense masks) keep their usual paths.
     """
+    ctx = _active_seq_mesh()
+    if (
+        ctx is not None
+        and mask is None
+        and query.shape == key.shape == value.shape
+        and query.shape[2] % ctx[0].shape[ctx[1]] == 0
+        # Batch must also fill the mesh's batch axis (a ragged eval tail
+        # batch, deliberately run unsharded by train.loop.evaluate, falls
+        # through to the dense path instead of crashing shard_map).
+        and query.shape[0] % ctx[0].shape.get(ctx[2], 1) == 0
+    ):
+        from machine_learning_apache_spark_tpu.parallel.ring_attention import (
+            ring_attention,
+        )
+
+        mesh, seq_axis, batch_axis = ctx
+        return ring_attention(
+            query, key, value, mesh,
+            causal=causal, kv_valid=kv_valid,
+            seq_axis=seq_axis, batch_axis=batch_axis,
+        )
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu" and mask is None
     if use_pallas and mask is None:

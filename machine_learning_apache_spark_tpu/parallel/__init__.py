@@ -28,6 +28,7 @@ from machine_learning_apache_spark_tpu.parallel.data_parallel import (
 from machine_learning_apache_spark_tpu.parallel.pipeline_parallel import (
     pipeline_apply,
 )
+from machine_learning_apache_spark_tpu.ops.attention import sequence_parallel
 from machine_learning_apache_spark_tpu.parallel.ring_attention import (
     ring_attention,
 )
@@ -58,6 +59,7 @@ __all__ = [
     "params_fingerprint",
     "pipeline_apply",
     "ring_attention",
+    "sequence_parallel",
     "DEFAULT_RULES",
     "logical_to_mesh_spec",
     "mesh_shardings",

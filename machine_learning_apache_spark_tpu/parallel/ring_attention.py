@@ -51,8 +51,12 @@ def _block_update(q, k, v, m, l, acc, bias, scale):
     if bias is not None:
         s = s + bias
     m_new = jnp.maximum(m, s.max(axis=-1))
-    p = jnp.exp(s - m_new[..., None])
-    alpha = jnp.exp(m - m_new)
+    # NEG_INF-biased columns must contribute exactly zero even when the row
+    # max itself is NEG_INF (all-masked so far): exp(-inf - -inf) would be 1.
+    p = jnp.where(
+        s > NEG_INF * 0.5, jnp.exp(s - m_new[..., None]), 0.0
+    )
+    alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
     l_new = l * alpha + p.sum(axis=-1)
     acc_new = acc * alpha[..., None] + jnp.einsum(
         "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
@@ -60,9 +64,9 @@ def _block_update(q, k, v, m, l, acc, bias, scale):
     return m_new, l_new, acc_new
 
 
-def _ring_shard_fn(q, k, v, *, axis, causal, scale, mesh_axes):
+def _ring_shard_fn(q, k, v, kv_valid, *, axis, causal, scale, mesh_axes):
     """Per-device body under shard_map: q/k/v are the local sequence shards
-    ``[B, H, S_local, D]``."""
+    ``[B, H, S_local, D]``; kv_valid (may be None) is ``[B, S_local]``."""
     n = jax.lax.psum(1, axis)
     me = jax.lax.axis_index(axis)
     b, h, s_q, d = q.shape
@@ -83,33 +87,53 @@ def _ring_shard_fn(q, k, v, *, axis, causal, scale, mesh_axes):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, hop):
-        k_blk, v_blk, m, l, acc = carry
+        k_blk, v_blk, kv_blk, m, l, acc = carry
         # After `hop` forward rotations, this device holds the chunk that
         # started on device me - hop (mod n).
         src = (me - hop) % n
-        if causal:
-            # Global causal test, chunk-granular: ahead → -inf everywhere
-            # (contributes exactly zero), diagonal → local triangle,
-            # behind → no bias.
-            q_glob = me * s_q + q_pos  # [s_q]
-            k_glob = src * s_k + k_pos  # [s_k]
-            bias = jnp.where(
-                q_glob[:, None] >= k_glob[None, :], 0.0, NEG_INF
-            ).astype(jnp.float32)
-        else:
+
+        def attend(m, l, acc):
             bias = None
-        m, l, acc = _block_update(q, k_blk, v_blk, m, l, acc, bias, scale)
+            if causal:
+                # Global causal test, chunk-granular: diagonal → local
+                # triangle, behind → no bias (fully-ahead chunks never reach
+                # here — see the cond below).
+                q_glob = me * s_q + q_pos  # [s_q]
+                k_glob = src * s_k + k_pos  # [s_k]
+                bias = jnp.where(
+                    q_glob[:, None] >= k_glob[None, :], 0.0, NEG_INF
+                ).astype(jnp.float32)
+            if kv_blk is not None:
+                # Per-key padding validity rides the ring with its K/V chunk.
+                kv_bias = jnp.where(kv_blk, 0.0, NEG_INF).astype(jnp.float32)
+                kv_bias = kv_bias[:, None, None, :]  # [b, 1, 1, s_k]
+                bias = kv_bias if bias is None else bias + kv_bias
+            return _block_update(q, k_blk, v_blk, m, l, acc, bias, scale)
+
+        if causal:
+            # SKIP fully-ahead chunks — a real branch, not a zeroed compute:
+            # without it the causal ring does ~2× the necessary FLOPs.
+            fully_ahead = src * s_k > me * s_q + (s_q - 1)
+            m, l, acc = jax.lax.cond(
+                fully_ahead, lambda m, l, acc: (m, l, acc), attend, m, l, acc
+            )
+        else:
+            m, l, acc = attend(m, l, acc)
         # Rotate K/V one hop around the ring for the next step. The final
         # rotation restores the original layout (and keeps the scan carry
         # shape uniform); XLA overlaps it with this step's compute.
         k_blk = jax.lax.ppermute(k_blk, axis, perm)
         v_blk = jax.lax.ppermute(v_blk, axis, perm)
-        return (k_blk, v_blk, m, l, acc), None
+        if kv_blk is not None:
+            kv_blk = jax.lax.ppermute(kv_blk, axis, perm)
+        return (k_blk, v_blk, kv_blk, m, l, acc), None
 
-    (k, v, m, l, acc), _ = jax.lax.scan(
-        step, (k, v, m, l, acc), jnp.arange(n)
+    (k, v, kv_valid, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, kv_valid, m, l, acc), jnp.arange(n)
     )
-    return (acc / l[..., None]).astype(q.dtype)
+    # Rows with zero valid keys (fully-padded) emit zeros, never NaN.
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe_l[..., None]).astype(q.dtype)
 
 
 def ring_attention(
@@ -119,6 +143,7 @@ def ring_attention(
     mesh: Mesh,
     *,
     causal: bool = False,
+    kv_valid: jnp.ndarray | None = None,
     seq_axis: str = SEQ_AXIS,
     batch_axis: str | None = DATA_AXIS,
 ) -> jnp.ndarray:
@@ -128,6 +153,10 @@ def ring_attention(
     is in the mesh) — a drop-in for ``scaled_dot_product_attention`` on
     sequences too long for one chip. Self-attention shapes only (Sq == Sk);
     the ``seq_axis`` size must divide the global sequence length.
+
+    ``kv_valid`` (``[B, S]`` bool, True = attendable) is the per-key padding
+    mask of the MT model; its chunks ride the ring alongside K/V. Fully-
+    padded rows emit zeros (matching the flash kernel's convention).
 
     Differentiable: the backward pass re-runs the ring in reverse via the
     transpose of ``ppermute`` inside the scan.
@@ -143,9 +172,17 @@ def ring_attention(
             f"sequence length {query.shape[2]} not divisible by "
             f"{seq_axis}={n}"
         )
+    if kv_valid is not None and kv_valid.shape != (
+        query.shape[0], query.shape[2],
+    ):
+        raise ValueError(
+            f"kv_valid must be [batch={query.shape[0]}, "
+            f"seq={query.shape[2]}], got {kv_valid.shape}"
+        )
     scale = 1.0 / (query.shape[-1] ** 0.5)
     batch = batch_axis if batch_axis in mesh.shape else None
     spec = P(batch, None, seq_axis, None)
+    valid_spec = P(batch, seq_axis)
     spec_axes = (seq_axis,) if batch is None else (batch, seq_axis)
     fn = jax.shard_map(
         functools.partial(
@@ -156,7 +193,7 @@ def ring_attention(
             mesh_axes=spec_axes,
         ),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, valid_spec if kv_valid is not None else P()),
         out_specs=spec,
     )
-    return fn(query, key, value)
+    return fn(query, key, value, kv_valid)

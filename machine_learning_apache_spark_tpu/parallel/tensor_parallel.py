@@ -17,6 +17,7 @@ plain jitted step from ``train.loop`` — TP changes only where arrays live.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Mapping
 
 import flax.linen as nn
@@ -98,6 +99,54 @@ def shard_params(
     shardings = mesh_shardings(params, mesh, rules)
     unboxed = nn.unbox(params)
     return jax.tree.map(jax.device_put, unboxed, shardings)
+
+
+def _divisible_sharding(sharding: NamedSharding, x) -> NamedSharding:
+    """Drop sharded dims the array cannot fill evenly (e.g. a vocab head of
+    odd size on a 4-way model axis) — replicate those dims instead of
+    crashing placement. Vocab padding to the axis size is the perf-clean
+    alternative left to callers."""
+    mesh = sharding.mesh
+    changed = False
+    entries = []
+    ndim = getattr(x, "ndim", 0)  # python scalars ride along replicated
+    spec = tuple(sharding.spec) + (None,) * (ndim - len(sharding.spec))
+    for dim, entry in enumerate(spec):
+        if entry is not None:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            ways = math.prod(mesh.shape[a] for a in axes)
+            if x.shape[dim] % ways:
+                entry = None
+                changed = True
+        entries.append(entry)
+    return NamedSharding(mesh, P(*entries)) if changed else sharding
+
+
+def shard_state(state: Any, mesh: Mesh, rules: Mapping[str, str | None] | None = None) -> Any:
+    """Place a ``TrainState`` (or any pytree) per its logical annotations.
+
+    Boxed params land tensor-sharded over the mesh's ``"model"`` axis,
+    optimizer moments follow them (optax preserves the boxed structure), and
+    every plain leaf is replicated — so on a pure-DP mesh this degenerates to
+    whole-replica placement, the reference's DDP semantics
+    (``distributed_cnn.py:156``), while a dp×tp mesh gets Megatron-style
+    layouts with no train-step change. Dims whose size the mesh axis does
+    not divide fall back to replication (see ``_divisible_sharding``).
+    """
+    unboxed = nn.unbox(state)
+    specs = nn.get_partition_spec(state)
+
+    def place(spec, x):
+        # get_partition_spec yields None (not P()) for non-array leaves like
+        # the step counter — an empty-pytree landmine under tree.map, so it
+        # is treated as a leaf here and replicated.
+        p = logical_to_mesh_spec(spec, mesh, rules) if isinstance(spec, P) else P()
+        return jax.device_put(x, _divisible_sharding(NamedSharding(mesh, p), x))
+
+    return jax.tree.map(
+        place, specs, unboxed,
+        is_leaf=lambda s: s is None or isinstance(s, P),
+    )
 
 
 def with_sharding_constraint(x, mesh: Mesh, *names):

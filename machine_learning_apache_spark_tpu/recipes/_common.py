@@ -28,9 +28,20 @@ from machine_learning_apache_spark_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
-def resolve_mesh(use_mesh: bool = True):
-    """Data-parallel mesh over every addressable device, or None when a mesh
-    buys nothing (single device, single process)."""
+def resolve_mesh(
+    use_mesh: bool = True,
+    *,
+    model_parallel: int = 1,
+    sequence_parallel: int = 1,
+):
+    """Device mesh for a recipe, or None when a mesh buys nothing.
+
+    Default is pure data parallelism over every addressable device (the
+    reference's DDP world). ``model_parallel=N`` carves an inner ``"model"``
+    axis (tensor parallelism over the zoo's logical annotations);
+    ``sequence_parallel=N`` carves a ``"seq"`` axis for ring attention. The
+    remaining devices form the ``"data"`` axis.
+    """
     if jax.process_count() > 1 and not use_mesh:
         # Without a mesh there is no gradient sync: each rank would train an
         # independent replica on its shard and rank 0's metrics would
@@ -40,7 +51,31 @@ def resolve_mesh(use_mesh: bool = True):
             "independent unsynchronized replicas; run single-process or "
             "keep use_mesh=True"
         )
-    if use_mesh and (jax.device_count() > 1 or jax.process_count() > 1):
+    if not use_mesh and (model_parallel > 1 or sequence_parallel > 1):
+        raise ValueError("model/sequence parallelism requires use_mesh=True")
+    have_devices = jax.device_count() > 1 or jax.process_count() > 1
+    if not have_devices and (model_parallel > 1 or sequence_parallel > 1):
+        # Never silently drop a requested parallelism mode: the user would
+        # believe TP/SP was exercised when it wasn't.
+        raise ValueError(
+            f"model_parallel={model_parallel}/sequence_parallel="
+            f"{sequence_parallel} requested but only "
+            f"{jax.device_count()} device(s) are available"
+        )
+    if use_mesh and have_devices:
+        from machine_learning_apache_spark_tpu.parallel.mesh import (
+            MODEL_AXIS,
+            SEQ_AXIS,
+            make_mesh,
+        )
+
+        axes = {DATA_AXIS: -1}
+        if model_parallel > 1:
+            axes[MODEL_AXIS] = model_parallel
+        if sequence_parallel > 1:
+            axes[SEQ_AXIS] = sequence_parallel
+        if len(axes) > 1:
+            return make_mesh(axes)
         return data_parallel_mesh()
     return None
 

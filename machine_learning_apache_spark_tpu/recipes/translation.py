@@ -58,6 +58,13 @@ class TranslationRecipe:
     # None → platform default (bfloat16 on TPU's MXU, float32 elsewhere);
     # an explicit dtype string is honored on any platform.
     dtype: str | None = None
+    # Parallelism beyond DP (SURVEY.md §2.3): an inner "model" mesh axis
+    # tensor-shards the zoo's annotated weights; a "seq" axis routes
+    # self-attention through the ppermute ring (sequence lengths that the
+    # axis size divides — the encoder's max_len — ride the ring, others fall
+    # through to the dense/flash path).
+    model_parallel: int = 1
+    sequence_parallel: int = 1
 
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
@@ -79,7 +86,12 @@ def make_translation_loss(model, pad_id: int, *, train: bool = True):
     return loss_fn
 
 
-def train_translator(recipe: TranslationRecipe | None = None, **overrides) -> dict:
+def train_translator(
+    recipe: TranslationRecipe | None = None,
+    *,
+    _return_state: bool = False,
+    **overrides,
+) -> dict:
     r = with_overrides(recipe or TranslationRecipe(), overrides)
 
     if r.data_root:
@@ -118,7 +130,11 @@ def train_translator(recipe: TranslationRecipe | None = None, **overrides) -> di
     )
     model = Transformer(cfg)
 
-    mesh = resolve_mesh(r.use_mesh)
+    mesh = resolve_mesh(
+        r.use_mesh,
+        model_parallel=r.model_parallel,
+        sequence_parallel=r.sequence_parallel,
+    )
     train_loader, val_loader = make_loaders(
         train_ds, val_ds, batch_size=r.batch_size, mesh=mesh, seed=r.seed
     )
@@ -131,24 +147,43 @@ def train_translator(recipe: TranslationRecipe | None = None, **overrides) -> di
         tx=make_optimizer("adam", r.learning_rate),
     )
 
-    result = fit(
-        state,
-        make_translation_loss(model, cfg.pad_id),
-        train_loader,
-        epochs=r.epochs,
-        rng=jax.random.key(r.seed),
-        mesh=mesh,
-        log_every=r.log_every,
+    # Under sequence parallelism the attention dispatch context must wrap
+    # tracing (fit/evaluate jit their steps on first batch).
+    import contextlib
+
+    from machine_learning_apache_spark_tpu.ops.attention import (
+        sequence_parallel,
     )
-    metrics = evaluate(
-        result.state,
-        make_translation_loss(model, cfg.pad_id, train=False),
-        val_loader,
-        mesh=mesh,
+
+    sp_ctx = (
+        sequence_parallel(mesh)
+        if mesh is not None and r.sequence_parallel > 1
+        else contextlib.nullcontext()
     )
-    return summarize(
+    with sp_ctx:
+        result = fit(
+            state,
+            make_translation_loss(model, cfg.pad_id),
+            train_loader,
+            epochs=r.epochs,
+            rng=jax.random.key(r.seed),
+            mesh=mesh,
+            log_every=r.log_every,
+        )
+        metrics = evaluate(
+            result.state,
+            make_translation_loss(model, cfg.pad_id, train=False),
+            val_loader,
+            mesh=mesh,
+        )
+    out = summarize(
         result,
         metrics,
         src_vocab=len(src_pipe.vocab),
         trg_vocab=len(trg_pipe.vocab),
     )
+    if _return_state:
+        # Test/inspection hook — the state is NOT picklable across the
+        # launcher boundary, so it never rides the default result dict.
+        out["state"] = result.state
+    return out

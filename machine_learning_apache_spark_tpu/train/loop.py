@@ -20,7 +20,7 @@ from typing import Any, Callable, Iterable
 import jax
 import jax.numpy as jnp
 
-from machine_learning_apache_spark_tpu.parallel.mesh import replicate, shard_batch
+from machine_learning_apache_spark_tpu.parallel.mesh import shard_batch
 from machine_learning_apache_spark_tpu.train.metrics import MetricBundle, logits_accuracy
 from machine_learning_apache_spark_tpu.train.state import TrainState
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
@@ -106,7 +106,14 @@ def fit(
         profile_dir, start=profile_window[0], stop=profile_window[1]
     )
     if mesh is not None:
-        state = replicate(mesh, state)
+        # Logical-annotation-aware placement: DP-only meshes replicate (DDP
+        # whole-replica semantics); a mesh with a "model" axis tensor-shards
+        # annotated params and their optimizer moments (SURVEY.md §2.3).
+        from machine_learning_apache_spark_tpu.parallel.tensor_parallel import (
+            shard_state,
+        )
+
+        state = shard_state(state, mesh)
 
     total_timer = Timer("train").start()
     span_timer = Timer("span").start()

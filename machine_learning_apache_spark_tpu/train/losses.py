@@ -13,10 +13,18 @@ import jax.numpy as jnp
 import optax
 
 
-def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """Mean softmax cross-entropy over integer labels — ``nn.CrossEntropyLoss``
-    default semantics (``pytorch_cnn.py:108``)."""
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+def cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray, *, reduction: str = "mean"
+) -> jnp.ndarray:
+    """Softmax cross-entropy over integer labels — ``nn.CrossEntropyLoss``
+    semantics (``pytorch_cnn.py:108``): ``reduction="mean"`` (default) or
+    ``"none"`` for per-example losses (weighted-mean callers)."""
+    per_example = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    if reduction == "none":
+        return per_example
+    if reduction != "mean":
+        raise ValueError(f"unknown reduction {reduction!r}")
+    return per_example.mean()
 
 
 def masked_token_cross_entropy(

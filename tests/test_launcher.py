@@ -106,6 +106,61 @@ class TestDistributorLocal:
         )
         assert out == {"rank": 0, "world": 2, "sum": 3.0}
 
+    @pytest.mark.slow
+    def test_gang_dp_train_step_parity(self):
+        """A REAL cross-process psum train step (VERDICT round-2 item 6): a
+        2-process gang builds a 2-device mesh, each rank feeds its shard,
+        grads sync through the compiled collective, replicas stay bit-level
+        in sync, and the loss trajectory + final params equal the
+        single-process full-batch run
+        (``distributed_multilayer_perceptron.py:177-181`` parity)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        out = Distributor(num_processes=2, platform="cpu", timeout=240).run(
+            "launcher_workers:dp_train_step_parity"
+        )
+        assert out["world"] == 2
+        assert out["divergence"] == 0.0
+
+        # Single-process reference: same init, same data, full batch.
+        from machine_learning_apache_spark_tpu.models import MLP
+        from machine_learning_apache_spark_tpu.parallel.data_parallel import (
+            params_fingerprint,
+        )
+        from machine_learning_apache_spark_tpu.train.losses import cross_entropy
+        from machine_learning_apache_spark_tpu.train.state import (
+            TrainState,
+            make_optimizer,
+        )
+
+        rng = np.random.default_rng(0)
+        feats = jnp.asarray(rng.normal(size=(16, 4)).astype(np.float32))
+        labels = jnp.asarray(rng.integers(0, 3, 16).astype(np.int64))
+        model = MLP(layers=(4, 5, 3))
+        params = model.init(jax.random.key(0), jnp.ones((1, 4)))["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.1)
+        )
+
+        @jax.jit
+        def step(state):
+            def loss_fn(p):
+                return cross_entropy(model.apply({"params": p}, feats), labels)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads), loss
+
+        expected_losses = []
+        for _ in range(3):
+            state, loss = step(state)
+            expected_losses.append(float(loss))
+        np.testing.assert_allclose(out["losses"], expected_losses, rtol=1e-5)
+        np.testing.assert_allclose(
+            out["fingerprint"], params_fingerprint(state.params), rtol=1e-5
+        )
+
 
 class TestCommandsForHosts:
     def test_command_lines(self):

@@ -71,6 +71,49 @@ class TestTranslationRecipe:
         assert "test_loss" in out
 
 
+class TestParallelismFlags:
+    """TP/SP reachable from the recipe surface (VERDICT round-2 item 10):
+    a user flips a flag, the mesh/context/placement happen inside."""
+
+    def test_model_parallel_recipe(self):
+        from machine_learning_apache_spark_tpu.parallel.mesh import MODEL_AXIS
+
+        out = train_translator(
+            epochs=1,
+            synthetic_n=128,
+            batch_size=8,
+            max_len=16,
+            d_model=32,
+            ffn_hidden=64,
+            num_heads=4,
+            log_every=0,
+            model_parallel=4,
+            _return_state=True,
+        )
+        assert out["history"][-1]["loss"] < 7.0
+        # TP sharding must survive fit: the FFN up-projection kernel stays
+        # split over the "model" axis after the optimizer updates.
+        kernel = out["state"].params["encoder"]["layer_0"]["ffn"]["up"]["kernel"]
+        import jax
+
+        assert MODEL_AXIS in jax.tree.leaves(tuple(kernel.sharding.spec))
+
+    def test_sequence_parallel_recipe(self):
+        out = train_translator(
+            epochs=1,
+            synthetic_n=128,
+            batch_size=8,
+            max_len=16,
+            d_model=32,
+            ffn_hidden=64,
+            num_heads=4,
+            log_every=0,
+            sequence_parallel=4,
+        )
+        assert out["history"][-1]["loss"] < 7.0
+        assert "test_loss" in out
+
+
 @pytest.mark.slow
 class TestDistributedRecipe:
     def test_mlp_under_distributor(self):

@@ -100,6 +100,170 @@ class TestRingParity:
         )
 
 
+class TestRingKvValid:
+    def test_kv_valid_matches_dense(self, seq_mesh):
+        """Per-key padding validity rides the ring; parity with the dense
+        padding-masked path."""
+        q, k, v = qkv(s=32)
+        lengths = jnp.asarray([20, 32])
+        kv_valid = jnp.arange(32)[None, :] < lengths[:, None]
+        dense = scaled_dot_product_attention(q, k, v, kv_valid[:, None, None, :])
+        ring = ring_attention(q, k, v, seq_mesh, kv_valid=kv_valid)
+        np.testing.assert_allclose(ring, dense, atol=1e-5)
+
+    def test_kv_valid_with_causal(self, seq_mesh):
+        q, k, v = qkv(s=32)
+        from machine_learning_apache_spark_tpu.ops.masks import combine_masks
+
+        kv_valid = jnp.arange(32)[None, :] < jnp.asarray([24, 32])[:, None]
+        dense_mask = combine_masks(
+            make_causal_mask(32), kv_valid[:, None, None, :]
+        )
+        dense = scaled_dot_product_attention(q, k, v, dense_mask)
+        ring = ring_attention(
+            q, k, v, seq_mesh, causal=True, kv_valid=kv_valid
+        )
+        np.testing.assert_allclose(ring, dense, atol=1e-5)
+
+    def test_fully_padded_row_emits_zeros(self, seq_mesh):
+        q, k, v = qkv(s=16)
+        kv_valid = jnp.stack([jnp.zeros(16, bool), jnp.ones(16, bool)])
+        ring = ring_attention(q, k, v, seq_mesh, kv_valid=kv_valid)
+        np.testing.assert_array_equal(np.asarray(ring)[0], 0.0)
+
+    def test_kv_valid_bad_shape_rejected(self, seq_mesh):
+        q, k, v = qkv(s=16)
+        with pytest.raises(ValueError, match="kv_valid"):
+            ring_attention(
+                q, k, v, seq_mesh, kv_valid=jnp.ones((2, 8), bool)
+            )
+
+
+class TestSequenceParallelDispatch:
+    """``sequence_parallel(mesh)`` routes zoo self-attention through the
+    ring with NO model change (VERDICT round-2 item 4)."""
+
+    def test_dot_product_attention_dispatches(self, dp_sp_mesh):
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            dot_product_attention,
+            sequence_parallel,
+        )
+
+        q, k, v = qkv(b=4, s=16)
+        kv_valid = jnp.arange(16)[None, :] < jnp.asarray([10, 16, 12, 16])[:, None]
+        dense = dot_product_attention(
+            q, k, v, causal=True, kv_valid=kv_valid, use_pallas=False
+        )
+        with sequence_parallel(dp_sp_mesh):
+            ring = dot_product_attention(q, k, v, causal=True, kv_valid=kv_valid)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), atol=1e-5)
+
+    def test_ragged_batch_falls_through(self, dp_sp_mesh):
+        """A batch that doesn't fill the mesh's data axis (evaluate's ragged
+        tail) must fall through to the dense path, not crash shard_map."""
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            dot_product_attention,
+            sequence_parallel,
+        )
+
+        q, k, v = qkv(b=3, s=16)  # 3 rows on a data=2 axis
+        with sequence_parallel(dp_sp_mesh):
+            got = dot_product_attention(q, k, v)
+        expected = scaled_dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
+
+    def test_cross_attention_falls_through(self, dp_sp_mesh):
+        """Sq != Sk must NOT hit the ring (cross-attention site)."""
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            dot_product_attention,
+            sequence_parallel,
+        )
+
+        q, _, _ = qkv(b=4, s=8)
+        k, v = qkv(b=4, s=16)[:2]
+        with sequence_parallel(dp_sp_mesh):
+            got = dot_product_attention(q, k, v)
+        expected = scaled_dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
+
+    def test_missing_axis_rejected(self):
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            sequence_parallel,
+        )
+
+        mesh = make_mesh({DATA_AXIS: 8})
+        with pytest.raises(ValueError, match="seq"):
+            with sequence_parallel(mesh):
+                pass
+
+    def test_transformer_trains_on_dp_sp_mesh(self, dp_sp_mesh):
+        """The MT Transformer trains under sequence_parallel on a dp×sp mesh
+        with no model change, matching the dp-only loss trajectory."""
+        from machine_learning_apache_spark_tpu.models import (
+            Transformer,
+            TransformerConfig,
+        )
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            sequence_parallel,
+        )
+        from machine_learning_apache_spark_tpu.train.losses import (
+            masked_token_cross_entropy,
+        )
+        from machine_learning_apache_spark_tpu.train.state import (
+            TrainState,
+            make_optimizer,
+        )
+
+        import flax.linen as nn
+
+        cfg = TransformerConfig(
+            src_vocab_size=50, trg_vocab_size=60, d_model=16, ffn_hidden=32,
+            num_heads=4, num_layers=1, max_len=16, dropout=0.0,
+        )
+        model = Transformer(cfg)
+        rng = jax.random.key(0)
+        src = jax.random.randint(rng, (4, 16), 1, 50, dtype=jnp.int32)
+        trg = jax.random.randint(rng, (4, 17), 1, 60, dtype=jnp.int32)
+        params = nn.unbox(model.init(rng, src, trg[:, :-1])["params"])
+
+        def loss_fn(params, src, trg):
+            logits = model.apply(
+                {"params": params}, src, trg[:, :-1], deterministic=True
+            )
+            return masked_token_cross_entropy(logits, trg[:, 1:], cfg.pad_id)
+
+        def train(n_steps, use_sp):
+            state = TrainState.create(
+                apply_fn=model.apply,
+                params=params,
+                tx=make_optimizer("adam", 1e-2),
+            )
+
+            @jax.jit
+            def step(state, src, trg):
+                loss, grads = jax.value_and_grad(loss_fn)(state.params, src, trg)
+                return state.apply_gradients(grads), loss
+
+            losses = []
+            for _ in range(n_steps):
+                if use_sp:
+                    from machine_learning_apache_spark_tpu.ops.attention import (
+                        sequence_parallel,
+                    )
+
+                    with sequence_parallel(dp_sp_mesh):
+                        state, loss = step(state, src, trg)
+                else:
+                    state, loss = step(state, src, trg)
+                losses.append(float(loss))
+            return losses
+
+        sp_losses = train(4, use_sp=True)
+        dp_losses = train(4, use_sp=False)
+        np.testing.assert_allclose(sp_losses, dp_losses, rtol=1e-4)
+        assert sp_losses[-1] < sp_losses[0]
+
+
 class TestRingValidation:
     def test_indivisible_seq_rejected(self, seq_mesh):
         q, k, v = qkv(s=30)
