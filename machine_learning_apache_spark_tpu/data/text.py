@@ -340,23 +340,36 @@ def translation_pipelines(
     pairs: Sequence[tuple[str, str]],
     *,
     max_len: int = 200,
+    trg_max_len: int | None = None,
     tokenizer: str | Callable[[str], list[str]] = "word_punct",
     **kwargs,
 ) -> tuple[TextPipeline, TextPipeline]:
     """The Multi30k dual-vocab chains: truncate(max_len-1) + eos + pad to
     exactly ``max_len`` (``pytorch_machine_translator.py:70-98``). Returns
     (src_pipeline, trg_pipeline) with *separate* vocabs, each defaulting to
-    its own ``<unk>`` (fixing quirk Q11)."""
+    its own ``<unk>`` (fixing quirk Q11).
+
+    ``trg_max_len`` (default: ``max_len``) pads the target stream to a
+    different fixed length — sequence-parallel training sets it to
+    ``max_len + 1`` so the teacher-forced decoder input (``trg[:, :-1]``,
+    one shorter) has length ``max_len`` and divides the ring's seq axis.
+    """
     src_texts = [s for s, _ in pairs]
     trg_texts = [t for _, t in pairs]
-    mk = lambda texts: TextPipeline.fit(
-        texts,
-        tokenizer,
-        # Truncate runs after the sos prepend, so max_len-1 keeps sos + up to
-        # max_len-2 content tokens, and the eos append lands within max_len —
-        # the reference's Truncate(199)+Pad(200) capacity exactly.
-        max_seq_len=max_len - 1,
-        fixed_len=max_len,
-        **kwargs,
+
+    def mk(texts, length):
+        return TextPipeline.fit(
+            texts,
+            tokenizer,
+            # Truncate runs after the sos prepend, so length-1 keeps sos + up
+            # to length-2 content tokens, and the eos append lands within
+            # length — the reference's Truncate(199)+Pad(200) capacity.
+            max_seq_len=length - 1,
+            fixed_len=length,
+            **kwargs,
+        )
+
+    return (
+        mk(src_texts, max_len),
+        mk(trg_texts, max_len if trg_max_len is None else trg_max_len),
     )
-    return mk(src_texts), mk(trg_texts)

@@ -59,6 +59,11 @@ class TransformerConfig:
     max_len: int = 200
     pad_id: int = 0
     dtype: jnp.dtype = jnp.float32  # bfloat16 for MXU-native training
+    # Extra all-zero-target columns on the LM head so its vocab dim divides
+    # a tensor-parallel "model" axis (Megatron-style vocab padding). Logits
+    # are sliced back to trg_vocab_size before they leave the model, so
+    # losses/decoding are exactly vocab-sized regardless of padding.
+    logit_pad: int = 0
 
 
 def _dense(features: int, cfg: TransformerConfig, name: str, logical_out: str):
@@ -394,15 +399,23 @@ class Transformer(nn.Module):
     def setup(self):
         self.encoder = Encoder(self.cfg)
         self.decoder = Decoder(self.cfg)
-        # LM head: d_model → trg vocab, the reference's Linear(512, |de|)
-        # (``transformer.py:271,283``), vocab axis model-sharded under TP.
+        # LM head: d_model → trg vocab (+ TP padding), the reference's
+        # Linear(512, |de|) (``transformer.py:271,283``), vocab axis
+        # model-sharded under TP.
         self.lm_head = nn.Dense(
-            self.cfg.trg_vocab_size,
+            self.cfg.trg_vocab_size + self.cfg.logit_pad,
             dtype=self.cfg.dtype,
             kernel_init=nn.with_partitioning(
                 nn.initializers.lecun_normal(), ("embed", "vocab")
             ),
         )
+
+    def _logits(self, y: jnp.ndarray) -> jnp.ndarray:
+        """LM head with the TP vocab padding sliced off."""
+        logits = self.lm_head(y)
+        if self.cfg.logit_pad:
+            logits = logits[..., : self.cfg.trg_vocab_size]
+        return logits
 
     def __call__(
         self,
@@ -438,7 +451,7 @@ class Transformer(nn.Module):
             self_causal=trg_mask is None,
             deterministic=deterministic,
         )
-        return self.lm_head(y)
+        return self._logits(y)
 
     def encode(self, src_tokens, *, deterministic: bool = True):
         return self.encoder(
@@ -461,7 +474,7 @@ class Transformer(nn.Module):
             self_causal=True,
             deterministic=True,
         )
-        return self.lm_head(y)
+        return self._logits(y)
 
     def decode_step(self, token, memory, src_valid, position, trg_valid=None):
         """One incremental step: ``token`` is ``[B, 1]``, self-attention
@@ -480,7 +493,7 @@ class Transformer(nn.Module):
             position_offset=position,
             deterministic=True,
         )
-        return self.lm_head(y)
+        return self._logits(y)
 
 
 def greedy_translate(

@@ -29,6 +29,9 @@ from machine_learning_apache_spark_tpu.parallel.mesh import (
     MODEL_AXIS,
     SEQ_AXIS,
 )
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
 
 # Logical axis name -> mesh axis name (None = replicated on that dim).
 # ``embed`` stays replicated: d_model is the contracting dim everywhere, so
@@ -101,11 +104,13 @@ def shard_params(
     return jax.tree.map(jax.device_put, unboxed, shardings)
 
 
-def _divisible_sharding(sharding: NamedSharding, x) -> NamedSharding:
+def _divisible_sharding(sharding: NamedSharding, x, name: str = "") -> NamedSharding:
     """Drop sharded dims the array cannot fill evenly (e.g. a vocab head of
     odd size on a 4-way model axis) — replicate those dims instead of
-    crashing placement. Vocab padding to the axis size is the perf-clean
-    alternative left to callers."""
+    crashing placement, LOUDLY (the user asked for TP; silently running
+    replicated would misrepresent what executed). Vocab padding to the axis
+    size is the perf-clean alternative left to callers (see
+    ``TransformerConfig.logit_pad``)."""
     mesh = sharding.mesh
     changed = False
     entries = []
@@ -116,6 +121,11 @@ def _divisible_sharding(sharding: NamedSharding, x) -> NamedSharding:
             axes = entry if isinstance(entry, tuple) else (entry,)
             ways = math.prod(mesh.shape[a] for a in axes)
             if x.shape[dim] % ways:
+                log.warning(
+                    "%s dim %d (size %d) does not divide mesh axis %r (%d "
+                    "ways); replicating that dim instead of sharding",
+                    name or "param", dim, x.shape[dim], entry, ways,
+                )
                 entry = None
                 changed = True
         entries.append(entry)
@@ -136,14 +146,17 @@ def shard_state(state: Any, mesh: Mesh, rules: Mapping[str, str | None] | None =
     unboxed = nn.unbox(state)
     specs = nn.get_partition_spec(state)
 
-    def place(spec, x):
+    def place(path, spec, x):
         # get_partition_spec yields None (not P()) for non-array leaves like
         # the step counter — an empty-pytree landmine under tree.map, so it
         # is treated as a leaf here and replicated.
         p = logical_to_mesh_spec(spec, mesh, rules) if isinstance(spec, P) else P()
-        return jax.device_put(x, _divisible_sharding(NamedSharding(mesh, p), x))
+        name = jax.tree_util.keystr(path)
+        return jax.device_put(
+            x, _divisible_sharding(NamedSharding(mesh, p), x, name)
+        )
 
-    return jax.tree.map(
+    return jax.tree_util.tree_map_with_path(
         place, specs, unboxed,
         is_leaf=lambda s: s is None or isinstance(s, P),
     )

@@ -103,7 +103,14 @@ def train_translator(
             max(r.synthetic_n // 8, 64), seed=r.seed + 1
         )
 
-    src_pipe, trg_pipe = translation_pipelines(pairs, max_len=r.max_len)
+    # Under SP, pad targets one longer so the teacher-forced decoder input
+    # (trg[:, :-1]) has length max_len and rides the ring like the encoder —
+    # otherwise its length max_len-1 shares no divisor with any seq axis.
+    src_pipe, trg_pipe = translation_pipelines(
+        pairs,
+        max_len=r.max_len,
+        trg_max_len=r.max_len + 1 if r.sequence_parallel > 1 else None,
+    )
     to_ids = lambda ps: (
         src_pipe([s for s, _ in ps]),
         trg_pipe([t for _, t in ps]),
@@ -114,6 +121,14 @@ def train_translator(
     cfg = TransformerConfig(
         src_vocab_size=len(src_pipe.vocab),
         trg_vocab_size=len(trg_pipe.vocab),
+        # Megatron-style vocab padding: keep the LM head — the largest
+        # matmul — shardable over the "model" axis whatever the vocab size;
+        # logits are sliced back inside the model, so losses are unchanged.
+        logit_pad=(
+            (-len(trg_pipe.vocab)) % r.model_parallel
+            if r.model_parallel > 1
+            else 0
+        ),
         d_model=r.d_model,
         ffn_hidden=r.ffn_hidden,
         num_heads=r.num_heads,
