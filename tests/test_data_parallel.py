@@ -34,8 +34,13 @@ def _setup(rng, n=64):
     params = model.init(jax.random.key(0), feats[:1])["params"]
 
     def new_state():
+        # Fresh param buffers per state: the fused train step DONATES its
+        # input state (in-place updates on TPU), so two trajectories must
+        # not share buffers.
         return TrainState.create(
-            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.03)
+            apply_fn=model.apply,
+            params=jax.tree.map(jnp.copy, params),
+            tx=make_optimizer("sgd", 0.03),
         )
 
     return model, new_state, (feats, labels)
