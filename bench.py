@@ -64,16 +64,53 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _init_backend():
-    """Initialize JAX, falling back to CPU if the default backend is broken.
+def _probe_default_backend(timeout_s: float) -> bool:
+    """Can the default backend initialize within ``timeout_s``?
 
-    The tunneled TPU plugin can fail at init; a bench that crashes there
-    produces no artifact at all, so degrade to CPU and say so.
+    Probed in a SUBPROCESS because a dead TPU tunnel makes ``jax.devices()``
+    hang (not raise) — and once the main process blocks inside backend init
+    there is no recovery. A hung probe is killed and we fall back to CPU
+    before this process ever touches the backend.
+    """
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            # Fast failure is a different diagnosis than a hang: surface the
+            # child's actual traceback so triage chases the real error.
+            log(
+                f"backend probe exited rc={proc.returncode}; stderr tail:\n"
+                + "\n".join(proc.stderr.strip().splitlines()[-5:])
+            )
+            return False
+        return True
+    except subprocess.TimeoutExpired:
+        log(f"backend probe hung past {timeout_s}s (dead tunnel?)")
+        return False
+    except Exception as e:
+        log(f"backend probe failed to launch ({e!r}); assuming usable")
+        return True
+
+
+def _init_backend():
+    """Initialize JAX, falling back to CPU if the default backend is broken
+    or hung — a bench that crashes or hangs produces no artifact at all.
     """
     import jax
 
     if os.environ.get("BENCH_PLATFORM"):  # e.g. "cpu" for smoke runs
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    else:
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+        if not _probe_default_backend(probe_timeout):
+            log("default backend unusable (see probe log); falling back to CPU")
+            jax.config.update("jax_platforms", "cpu")
     try:
         jax.devices()
     except Exception as e:
