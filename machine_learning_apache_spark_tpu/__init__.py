@@ -30,6 +30,48 @@ identifiers).
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# Backend override via the config API, applied at first package import —
+# the ``spark.master local`` analogue. On images whose sitecustomize
+# pre-registers an accelerator plugin, the JAX_PLATFORMS *env var* can be
+# ineffective (or leave a process pointed at a dead tunnel that hangs at
+# backend init); ``jax.config.update`` before the first backend touch is
+# the reliable lever, so expose it as one:
+#
+#   MLSPARK_PLATFORM=cpu MLSPARK_CPU_DEVICES=8 python examples/cnn.py
+#
+# No-ops (with a warning) if the backend was already initialized.
+if _os.environ.get("MLSPARK_PLATFORM") or _os.environ.get("MLSPARK_CPU_DEVICES"):
+    import jax as _jax
+
+    # jax.config.update("jax_platforms", ...) succeeds SILENTLY with no
+    # effect once a backend is initialized (no after-init validator in
+    # jax), so the staleness check must be explicit or the override
+    # silently no-ops — the exact misconfiguration this knob exists to
+    # surface.
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _too_late = _xb.backends_are_initialized()
+    except Exception:
+        _too_late = False
+    if _too_late:
+        import warnings as _warnings
+
+        _warnings.warn(
+            "MLSPARK_PLATFORM/MLSPARK_CPU_DEVICES set but the JAX backend "
+            "was already initialized; the override had no effect",
+            stacklevel=2,
+        )
+    else:
+        if _os.environ.get("MLSPARK_PLATFORM"):
+            _jax.config.update("jax_platforms", _os.environ["MLSPARK_PLATFORM"])
+        if _os.environ.get("MLSPARK_CPU_DEVICES"):
+            _jax.config.update(
+                "jax_num_cpu_devices", int(_os.environ["MLSPARK_CPU_DEVICES"])
+            )
+
 from machine_learning_apache_spark_tpu.session import Session, SessionBuilder
 
 __all__ = ["Session", "SessionBuilder", "__version__"]
