@@ -64,6 +64,11 @@ class TransformerConfig:
     # are sliced back to trg_vocab_size before they leave the model, so
     # losses/decoding are exactly vocab-sized regardless of padding.
     logit_pad: int = 0
+    # Rematerialize encoder/decoder layers under autodiff (jax.checkpoint):
+    # activations inside each layer are recomputed in the backward instead
+    # of saved — O(num_layers) → O(1) layer activations live at once, the
+    # FLOPs-for-HBM trade that makes long-context training fit.
+    remat: bool = False
 
 
 def _dense(features: int, cfg: TransformerConfig, name: str, logical_out: str):
@@ -268,8 +273,10 @@ class EncoderLayer(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x, mask=None, kv_valid=None, *, deterministic: bool = True
+        self, x, mask=None, kv_valid=None, deterministic: bool = True
     ):
+        # ``deterministic`` is positional-friendly: nn.remat marks it static
+        # by argnum (keyword-only args cannot be static under jax.checkpoint).
         drop = nn.Dropout(self.cfg.dropout, deterministic=deterministic)
         attn = MultiHeadAttention(self.cfg, name="self_attn")(
             x, mask=mask, kv_valid=kv_valid, deterministic=deterministic
@@ -296,9 +303,15 @@ class Encoder(nn.Module):
         x = SentenceEmbedding(self.cfg.src_vocab_size, self.cfg, name="embed")(
             src_tokens, deterministic=deterministic
         )
+        # static_argnums counts self at 0; deterministic is arg 4.
+        layer_cls = (
+            nn.remat(EncoderLayer, static_argnums=(4,))
+            if self.cfg.remat
+            else EncoderLayer
+        )
         for i in range(self.cfg.num_layers):
-            x = EncoderLayer(self.cfg, name=f"layer_{i}")(
-                x, src_mask, src_valid, deterministic=deterministic
+            x = layer_cls(self.cfg, name=f"layer_{i}")(
+                x, src_mask, src_valid, deterministic
             )
         return x
 
@@ -318,11 +331,12 @@ class DecoderLayer(nn.Module):
         cross_mask=None,
         trg_valid=None,
         memory_valid=None,
-        *,
         self_causal: bool = False,
         decode: bool = False,
         deterministic: bool = True,
     ):
+        # Flags are plain positional-friendly bools so nn.remat can mark
+        # them static by argnum (7, 8, 9; self counts at 0).
         drop = nn.Dropout(self.cfg.dropout, deterministic=deterministic)
         attn = MultiHeadAttention(self.cfg, name="self_attn")(
             y,
@@ -369,17 +383,24 @@ class Decoder(nn.Module):
             deterministic=deterministic,
             position_offset=position_offset,
         )
+        # Remat only on the training path: the decode cache is a mutable
+        # variable collection, which jax.checkpoint cannot rewind.
+        layer_cls = (
+            nn.remat(DecoderLayer, static_argnums=(7, 8, 9))
+            if self.cfg.remat and not decode
+            else DecoderLayer
+        )
         for i in range(self.cfg.num_layers):
-            y = DecoderLayer(self.cfg, name=f"layer_{i}")(
+            y = layer_cls(self.cfg, name=f"layer_{i}")(
                 y,
                 memory,
                 self_mask,
                 cross_mask,
                 trg_valid,
                 memory_valid,
-                self_causal=self_causal,
-                decode=decode,
-                deterministic=deterministic,
+                self_causal,
+                decode,
+                deterministic,
             )
         return y
 

@@ -65,6 +65,10 @@ class TranslationRecipe:
     # through to the dense/flash path).
     model_parallel: int = 1
     sequence_parallel: int = 1
+    # jax.checkpoint over encoder/decoder layers: recompute activations in
+    # the backward instead of saving them — the FLOPs-for-HBM trade for
+    # long-context / deep-stack training.
+    remat: bool = False
 
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
@@ -135,6 +139,7 @@ def train_translator(
         num_layers=r.num_layers,
         dropout=r.dropout,
         max_len=r.max_len,
+        remat=r.remat,
         dtype=jnp.dtype(r.dtype)
         if r.dtype is not None
         else (

@@ -166,3 +166,73 @@ class TestTransformer:
         params = model.init(jax.random.key(0), src, trg)
         out = model.apply(params, src, trg)
         assert out.dtype == jnp.bfloat16
+
+
+class TestRemat:
+    """cfg.remat rematerializes layers under autodiff (jax.checkpoint):
+    identical gradients, O(1) live layer activations — the long-context
+    FLOPs-for-HBM trade (goal spec; no reference counterpart)."""
+
+    def _grads(self, cfg, src, trg):
+        import flax.linen as nn
+
+        from machine_learning_apache_spark_tpu.models import Transformer
+        from machine_learning_apache_spark_tpu.train.losses import (
+            masked_token_cross_entropy,
+        )
+
+        model = Transformer(cfg)
+        params = nn.unbox(
+            model.init(jax.random.key(2), src, trg[:, :-1])["params"]
+        )
+
+        def loss(p):
+            logits = model.apply(
+                {"params": p}, src, trg[:, :-1], deterministic=True
+            )
+            return masked_token_cross_entropy(logits, trg[:, 1:], cfg.pad_id)
+
+        return jax.grad(loss)(params)
+
+    def test_grads_match_plain(self):
+        import dataclasses
+
+        from machine_learning_apache_spark_tpu.models import TransformerConfig
+
+        base = TransformerConfig(
+            src_vocab_size=50, trg_vocab_size=60, d_model=16, ffn_hidden=32,
+            num_heads=4, num_layers=2, max_len=16, dropout=0.0,
+        )
+        src = jax.random.randint(jax.random.key(0), (2, 12), 1, 50, dtype=jnp.int32)
+        trg = jax.random.randint(jax.random.key(1), (2, 13), 1, 60, dtype=jnp.int32)
+        plain = self._grads(base, src, trg)
+        remat = self._grads(dataclasses.replace(base, remat=True), src, trg)
+        for a, b in zip(jax.tree.leaves(plain), jax.tree.leaves(remat)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_decode_unaffected(self):
+        """The KV-cache decode path must bypass remat (mutable cache cannot
+        be rewound) and stay output-identical to the non-remat model."""
+        import dataclasses
+
+        from machine_learning_apache_spark_tpu.models import TransformerConfig
+        from machine_learning_apache_spark_tpu.models.transformer import (
+            greedy_translate_cached,
+        )
+
+        base = TransformerConfig(
+            src_vocab_size=50, trg_vocab_size=60, d_model=16, ffn_hidden=32,
+            num_heads=4, num_layers=2, max_len=12, dropout=0.0,
+        )
+        src = jax.random.randint(jax.random.key(0), (2, 9), 1, 50, dtype=jnp.int32)
+        from machine_learning_apache_spark_tpu.models import Transformer
+
+        params = Transformer(base).init(jax.random.key(1), src, src)["params"]
+        out_plain = greedy_translate_cached(
+            Transformer(base), params, src, max_new_tokens=8
+        )
+        out_remat = greedy_translate_cached(
+            Transformer(dataclasses.replace(base, remat=True)), params, src,
+            max_new_tokens=8,
+        )
+        np.testing.assert_array_equal(np.asarray(out_plain), np.asarray(out_remat))
