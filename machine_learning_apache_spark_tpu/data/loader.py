@@ -92,6 +92,9 @@ class DataLoader:
     - ``collate``: optional ``fn(tuple_of_arrays) -> batch pytree`` applied per
       batch on the host (the tokenize-outside-the-step seam; the reference
       tokenizes *inside* its hot loop, ``pytorch_lstm.py:148``).
+    - ``prefetch``: assemble up to N batches ahead on a background thread,
+      overlapping host batch prep with async-dispatched device steps
+      (SURVEY.md §7 hard parts: input pipelines off the hot path).
     """
 
     def __init__(
@@ -104,6 +107,7 @@ class DataLoader:
         drop_last: bool = True,
         seed: int = 0,
         collate: Callable[[tuple], Any] | None = None,
+        prefetch: int = 0,
     ) -> None:
         if shuffle and sampler is not None:
             raise ValueError(
@@ -117,6 +121,9 @@ class DataLoader:
         self.drop_last = drop_last
         self.seed = seed
         self.collate = collate
+        if prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {prefetch}")
+        self.prefetch = prefetch
         self._epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -133,7 +140,7 @@ class DataLoader:
             )
         return np.arange(len(self.dataset))
 
-    def __iter__(self) -> Iterator:
+    def _batches(self) -> Iterator:
         order = self._order()
         stop = (
             len(order) - self.batch_size + 1 if self.drop_last else len(order)
@@ -143,6 +150,70 @@ class DataLoader:
             batch = self.dataset[idx]
             yield self.collate(batch) if self.collate else batch
 
+    def __iter__(self) -> Iterator:
+        if self.prefetch > 0:
+            return _prefetch_iter(self._batches(), self.prefetch)
+        return self._batches()
+
     def __len__(self) -> int:
         n = len(self.sampler) if self.sampler is not None else len(self.dataset)
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+
+def _prefetch_iter(it: Iterator, depth: int) -> Iterator:
+    """Pull ``it`` on a background thread into a bounded queue.
+
+    The TPU step is dispatched async, so the device computes while Python
+    prepares the NEXT batch — but only if that prep isn't serialized behind
+    the dispatch loop. A daemon thread assembles batches ahead (gather /
+    tokenize-collate release the GIL in the native paths), bounded at
+    ``depth`` to cap host memory. Worker exceptions re-raise at the
+    consuming ``next()``.
+    """
+    import queue as _queue
+    import threading
+
+    q: _queue.Queue = _queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END, _ERR = object(), object()
+
+    def _put(item) -> bool:
+        # Bounded-wait put so an abandoned consumer (mid-epoch exception,
+        # next(iter(loader)) peek) doesn't leave this thread blocked forever
+        # pinning `depth` batches — the stop event is honored within 100ms.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in it:
+                if not _put(item):
+                    return
+        except BaseException as e:  # re-raised on the consumer side
+            _put((_ERR, e))
+        else:
+            _put(_END)
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                raise item[1]
+            yield item
+    finally:
+        # Runs on normal exhaustion, consumer exception, and GeneratorExit
+        # (abandonment): release the worker and drop queued batches.
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except _queue.Empty:
+            pass

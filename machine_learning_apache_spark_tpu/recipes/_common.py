@@ -151,6 +151,9 @@ def make_loaders(
         drop_last=True,
         seed=seed,
         collate=collate,
+        # Assemble ahead on a background thread: the jitted step dispatches
+        # async, so the device trains while the host gathers/collates.
+        prefetch=2,
     )
     test_loader = None
     if test_ds is not None:

@@ -142,3 +142,91 @@ class TestSyntheticDatasets:
         pairs = synthetic_translation_pairs(20)
         for src, trg in pairs:
             assert [_TRG_MAP[w] for w in src.split()] == trg.split()
+
+
+class TestPrefetch:
+    """Background-thread batch prefetch: identical stream, bounded queue,
+    loud worker failures (SURVEY.md §7: input pipelines off the hot path)."""
+
+    def _ds(self, n=64):
+        import numpy as np
+
+        from machine_learning_apache_spark_tpu.data import ArrayDataset
+
+        rng = np.random.default_rng(0)
+        return ArrayDataset(
+            rng.normal(size=(n, 4)).astype(np.float32),
+            rng.integers(0, 3, n).astype(np.int64),
+        )
+
+    def test_same_batches_as_plain(self):
+        import numpy as np
+
+        from machine_learning_apache_spark_tpu.data import DataLoader
+
+        ds = self._ds()
+        plain = DataLoader(ds, 16, shuffle=True, seed=7)
+        pre = DataLoader(ds, 16, shuffle=True, seed=7, prefetch=2)
+        for (fa, la), (fb, lb) in zip(plain, pre, strict=True):
+            np.testing.assert_array_equal(fa, fb)
+            np.testing.assert_array_equal(la, lb)
+
+    def test_multiple_epochs_and_set_epoch(self):
+        import numpy as np
+
+        from machine_learning_apache_spark_tpu.data import DataLoader
+
+        ds = self._ds(32)
+        loader = DataLoader(ds, 8, shuffle=True, seed=3, prefetch=2)
+        first = [b[1].copy() for b in loader]
+        again = [b[1].copy() for b in loader]  # same epoch: same order
+        for a, b in zip(first, again, strict=True):
+            np.testing.assert_array_equal(a, b)
+        loader.set_epoch(1)
+        changed = np.concatenate([b[1] for b in loader])
+        assert not np.array_equal(np.concatenate(first), changed)
+
+    def test_worker_exception_propagates(self):
+        import pytest
+
+        from machine_learning_apache_spark_tpu.data import DataLoader
+
+        ds = self._ds(32)
+
+        def bad_collate(batch):
+            raise RuntimeError("collate exploded (intentional)")
+
+        loader = DataLoader(ds, 8, collate=bad_collate, prefetch=2)
+        with pytest.raises(RuntimeError, match="collate exploded"):
+            list(loader)
+
+    def test_negative_prefetch_rejected(self):
+        import pytest
+
+        from machine_learning_apache_spark_tpu.data import DataLoader
+
+        with pytest.raises(ValueError, match="prefetch"):
+            DataLoader(self._ds(8), 4, prefetch=-1)
+
+    def test_abandoned_iterator_releases_worker(self):
+        """Partially consuming a prefetch iterator must not leak a blocked
+        worker thread (mid-epoch exceptions / next(iter(loader)) peeks)."""
+        import gc
+        import threading
+        import time
+
+        from machine_learning_apache_spark_tpu.data import DataLoader
+
+        ds = self._ds(64)
+        before = threading.active_count()
+        for _ in range(5):
+            it = iter(DataLoader(ds, 8, prefetch=2))
+            next(it)
+            del it
+        gc.collect()
+        deadline = time.monotonic() + 5.0
+        while threading.active_count() > before and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before, (
+            f"{threading.active_count() - before} leaked prefetch workers"
+        )
