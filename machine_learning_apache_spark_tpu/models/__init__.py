@@ -10,6 +10,7 @@ from machine_learning_apache_spark_tpu.models.cnn import TinyVGG, FashionMNISTMo
 from machine_learning_apache_spark_tpu.models.lstm import LSTMClassifier
 from machine_learning_apache_spark_tpu.models.transformer import (
     Transformer,
+    beam_translate,
     greedy_translate,
     greedy_translate_cached,
     Encoder,
@@ -23,6 +24,7 @@ __all__ = [
     "FashionMNISTModel",
     "LSTMClassifier",
     "Transformer",
+    "beam_translate",
     "greedy_translate",
     "greedy_translate_cached",
     "Encoder",

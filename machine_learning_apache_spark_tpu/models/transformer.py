@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from machine_learning_apache_spark_tpu.ops.attention import (
+    NEG_INF,
     dot_product_attention,
 )
 from machine_learning_apache_spark_tpu.ops.masks import (
@@ -571,6 +572,185 @@ def greedy_translate(
     return ys
 
 
+def _prime_decode_cache(decode_model, params, memory, src_valid, gen_len, sos_id):
+    """Cache-priming call shared by the cached decoders: creates the zeroed
+    self-attention K/V buffers AND projects the encoder memory's
+    cross-attention K/V once, storing them in the cache. The priming logits
+    are discarded; the init trace writes nothing into the self-attention
+    cache, so the first real step recomputes sos with identical semantics.
+    """
+    rows = memory.shape[0]
+    _, primed = decode_model.apply(
+        {"params": params},
+        jnp.full((rows, 1), sos_id, jnp.int32),
+        memory,
+        src_valid,
+        jnp.zeros((), jnp.int32),
+        jnp.ones((rows, gen_len), bool),
+        method=Transformer.decode_step,
+        mutable=["cache"],
+    )
+    return primed["cache"]
+
+
+def _validate_max_new_tokens(max_new_tokens, cfg):
+    if max_new_tokens is None:
+        return cfg.max_len - 1
+    if not 1 <= max_new_tokens <= cfg.max_len - 1:
+        raise ValueError(
+            f"max_new_tokens must be in [1, {cfg.max_len - 1}], got "
+            f"{max_new_tokens}"
+        )
+    return max_new_tokens
+
+
+def beam_translate(
+    model: "Transformer",
+    params,
+    src_tokens: jnp.ndarray,
+    *,
+    beam_size: int = 4,
+    max_new_tokens: int | None = None,
+    length_penalty: float = 0.6,
+    sos_id: int = 1,
+    eos_id: int = 2,
+) -> jnp.ndarray:
+    """KV-cache beam search — the inference path the reference never ships,
+    taken past greedy.
+
+    TPU-first shape discipline: beams are flat-batched (``B·K`` rows share
+    one decode cache), every step is one fused program inside a single
+    ``lax.scan`` (top-k over ``K·V``, beam reorder via gather, cache rows
+    gathered alongside), and nothing is data-dependently shaped. Finished
+    beams extend only with ``pad`` at zero cost; hypothesis selection uses
+    the GNMT length penalty ``((5+L)/6)^alpha`` (``length_penalty=0`` scores
+    raw log-probs; ``beam_size=1`` reproduces greedy decoding exactly).
+
+    Returns ``[B, max_new_tokens + 1]`` int32 ids (leading ``sos``, rows
+    padded after their ``eos``) — the ``greedy_translate`` contract.
+    """
+    cfg = model.cfg
+    pad = cfg.pad_id
+    max_new_tokens = _validate_max_new_tokens(max_new_tokens, cfg)
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    b = src_tokens.shape[0]
+    k = beam_size
+    gen_len = max_new_tokens + 1
+    vocab = cfg.trg_vocab_size
+
+    src_valid = src_tokens != pad
+    memory = model.apply(
+        {"params": params}, src_tokens, method=Transformer.encode
+    )
+    # Tile encoder outputs to the flat beam batch: row b*k + j is beam j of
+    # sentence b.
+    memory = jnp.repeat(memory, k, axis=0)
+    src_valid_t = jnp.repeat(src_valid, k, axis=0)
+
+    decode_model = Transformer(dataclasses.replace(cfg, max_len=gen_len))
+    cache = _prime_decode_cache(
+        decode_model, params, memory, src_valid_t, gen_len, sos_id
+    )
+
+    ys = jnp.full((b, k, gen_len), pad, jnp.int32)
+    ys = ys.at[:, :, 0].set(sos_id)
+    scores = jnp.zeros((b, k), jnp.float32)
+    finished = jnp.zeros((b, k), bool)
+    lengths = jnp.zeros((b, k), jnp.int32)  # generated tokens incl. eos
+    # GNMT-style completed-hypothesis set (capacity 1 — the best): a
+    # finished beam can be evicted from the live set by raw-score top-k, so
+    # its penalized score/tokens are banked the step it finishes.
+    best_score = jnp.full((b,), NEG_INF, jnp.float32)
+    best_ys = jnp.full((b, gen_len), pad, jnp.int32)
+
+    def _penalize(score, length):
+        return score / ((5.0 + length.astype(jnp.float32)) / 6.0) ** length_penalty
+
+    def reorder_cache(tree, beam_idx):
+        def gather(path, leaf):
+            # Cross-attention memory K/V (cached_mem_*) are identical across
+            # beams of one sentence (tiled from one encode) — gathering them
+            # would be pure HBM traffic; scalars (cache_index) likewise ride.
+            if any("cached_mem" in str(p) for p in path):
+                return leaf
+            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == b * k:
+                x = leaf.reshape(b, k, *leaf.shape[1:])
+                idx = beam_idx.reshape(b, k, *([1] * (leaf.ndim - 1)))
+                x = jnp.take_along_axis(x, idx, axis=1)
+                return x.reshape(b * k, *leaf.shape[1:])
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(gather, tree)
+
+    def step(carry, t):
+        ys, scores, finished, lengths, best_score, best_ys, cache = carry
+        token = jax.lax.dynamic_slice_in_dim(ys, t, 1, axis=2)  # [b,k,1]
+        logits, updated = decode_model.apply(
+            {"params": params, "cache": cache},
+            token.reshape(b * k, 1),
+            memory,
+            src_valid_t,
+            t,
+            (ys != pad).reshape(b * k, gen_len),
+            method=Transformer.decode_step,
+            mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(
+            logits[:, 0, :].astype(jnp.float32), axis=-1
+        ).reshape(b, k, vocab)
+        # Finished beams extend only with pad, at zero cost.
+        pad_only = jnp.full((vocab,), NEG_INF).at[pad].set(0.0)
+        logp = jnp.where(finished[:, :, None], pad_only, logp)
+        total = scores[:, :, None] + logp  # [b, k, vocab]
+        # Step 0: all beams are identical copies of sos — search beam 0 only,
+        # or top-k would return k copies of the same hypothesis.
+        total = jnp.where(
+            (t == 0) & (jnp.arange(k)[None, :, None] > 0), NEG_INF, total
+        )
+        new_scores, flat_idx = jax.lax.top_k(total.reshape(b, k * vocab), k)
+        beam_idx = flat_idx // vocab  # [b, k] which parent beam
+        token = (flat_idx % vocab).astype(jnp.int32)
+
+        gathered = lambda x: jnp.take_along_axis(x, beam_idx, axis=1)
+        was_finished = gathered(finished)
+        ys = jnp.take_along_axis(ys, beam_idx[:, :, None], axis=1)
+        ys = jax.lax.dynamic_update_slice_in_dim(
+            ys, token[:, :, None], t + 1, axis=2
+        )
+        lengths = gathered(lengths) + (~was_finished).astype(jnp.int32)
+        newly_finished = ~was_finished & (token == eos_id)
+        finished = was_finished | (token == eos_id)
+        # Bank the best newly finished hypothesis before top-k can evict it.
+        cand = jnp.where(newly_finished, _penalize(new_scores, lengths), NEG_INF)
+        cand_beam = jnp.argmax(cand, axis=1)  # [b]
+        cand_score = jnp.take_along_axis(cand, cand_beam[:, None], axis=1)[:, 0]
+        cand_ys = jnp.take_along_axis(
+            ys, cand_beam[:, None, None], axis=1
+        )[:, 0, :]
+        better = cand_score > best_score
+        best_score = jnp.where(better, cand_score, best_score)
+        best_ys = jnp.where(better[:, None], cand_ys, best_ys)
+        cache = reorder_cache(updated["cache"], beam_idx)
+        return (
+            ys, new_scores, finished, lengths, best_score, best_ys, cache
+        ), None
+
+    (ys, scores, finished, lengths, best_score, best_ys, _), _ = jax.lax.scan(
+        step,
+        (ys, scores, finished, lengths, best_score, best_ys, cache),
+        jnp.arange(max_new_tokens),
+    )
+
+    # Selection: the banked best finished hypothesis wins when one exists
+    # (every finished beam was banked the step it finished, so none is ever
+    # lost to eviction); otherwise the best live beam by penalized score.
+    live_best = jnp.argmax(_penalize(scores, lengths), axis=1)  # [b]
+    live_ys = jnp.take_along_axis(ys, live_best[:, None, None], axis=1)[:, 0, :]
+    use_banked = best_score > NEG_INF * 0.5
+    return jnp.where(use_banked[:, None], best_ys, live_ys)
+
+
 def greedy_translate_cached(
     model: "Transformer",
     params,
@@ -590,13 +770,7 @@ def greedy_translate_cached(
     """
     cfg = model.cfg
     pad = cfg.pad_id
-    if max_new_tokens is None:
-        max_new_tokens = cfg.max_len - 1
-    if not 1 <= max_new_tokens <= cfg.max_len - 1:
-        raise ValueError(
-            f"max_new_tokens must be in [1, {cfg.max_len - 1}], got "
-            f"{max_new_tokens}"
-        )
+    max_new_tokens = _validate_max_new_tokens(max_new_tokens, cfg)
     b = src_tokens.shape[0]
     src_valid = src_tokens != pad
     memory = model.apply(
@@ -607,23 +781,9 @@ def greedy_translate_cached(
     # right-sizes every layer's K/V cache (and each step's attention span).
     gen_len = max_new_tokens + 1
     decode_model = Transformer(dataclasses.replace(cfg, max_len=gen_len))
-    # Cache-priming call: creates the (zeroed) self-attention K/V buffers AND
-    # projects the encoder memory's cross-attention K/V once, storing them in
-    # the cache — every scanned step below reuses them without touching the
-    # "kv" projection again. The priming logits are discarded; the scan's
-    # t=0 step recomputes sos with identical semantics (the init trace writes
-    # nothing into the self-attention cache).
-    _, primed = decode_model.apply(
-        {"params": params},
-        jnp.full((b, 1), sos_id, jnp.int32),
-        memory,
-        src_valid,
-        jnp.zeros((), jnp.int32),
-        jnp.ones((b, gen_len), bool),
-        method=Transformer.decode_step,
-        mutable=["cache"],
+    cache = _prime_decode_cache(
+        decode_model, params, memory, src_valid, gen_len, sos_id
     )
-    cache = primed["cache"]
 
     ys = jnp.full((b, gen_len), pad, jnp.int32)
     ys = ys.at[:, 0].set(sos_id)
