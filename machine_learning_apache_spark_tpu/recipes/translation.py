@@ -69,6 +69,14 @@ class TranslationRecipe:
     # the backward instead of saving them — the FLOPs-for-HBM trade for
     # long-context / deep-stack training.
     remat: bool = False
+    # Training-scale knobs beyond the reference's fixed-lr Adam: lr schedule
+    # ("constant" | "cosine" | "warmup_cosine" over the full run), linear
+    # warmup steps, global-norm gradient clipping, and gradient accumulation
+    # (grad_accum microbatches averaged per optimizer update).
+    schedule: str | None = None
+    warmup_steps: int = 0
+    grad_clip: float | None = None
+    grad_accum: int = 1
 
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
@@ -161,10 +169,28 @@ def train_translator(
 
     src0, trg0 = train_ds[:2]
     params = model.init(jax.random.key(r.seed), src0, trg0[:, :-1])["params"]
+    # total_steps counts OPTIMIZER updates: under accumulation only every
+    # grad_accum-th microbatch updates, and MultiSteps' microbatch counter
+    # carries across epoch boundaries — so divide the GLOBAL batch count.
+    n_micro = len(train_loader) * r.epochs
+    if r.grad_accum > max(n_micro, 1):
+        raise ValueError(
+            f"grad_accum={r.grad_accum} exceeds the run's {n_micro} "
+            "microbatches; the optimizer would never update"
+        )
+    total_updates = max(n_micro // max(r.grad_accum, 1), 1)
     state = TrainState.create(
         apply_fn=model.apply,
         params=params,
-        tx=make_optimizer("adam", r.learning_rate),
+        tx=make_optimizer(
+            "adam",
+            r.learning_rate,
+            schedule=r.schedule,
+            warmup_steps=r.warmup_steps,
+            total_steps=total_updates,
+            grad_clip=r.grad_clip,
+            accumulate_steps=r.grad_accum,
+        ),
     )
 
     # Under sequence parallelism the attention dispatch context must wrap

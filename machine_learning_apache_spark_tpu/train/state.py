@@ -4,7 +4,11 @@
 ``make_optimizer`` covers the reference's optimizer vocabulary: SGD
 (``pytorch_cnn.py:119`` lr=0.01, ``pytorch_multilayer_perceptron.py:96``
 lr=0.03) and Adam (``pytorch_lstm.py:127`` lr=1e-3,
-``pytorch_machine_translator.py:129``).
+``pytorch_machine_translator.py:129``) — plus the training-scale knobs the
+reference lacks: learning-rate schedules (warmup/cosine), global-norm
+gradient clipping, and gradient accumulation (K microbatch grads averaged
+into one update, so a per-chip-memory-bound batch can still train at the
+large effective batch a pod would use).
 """
 
 from __future__ import annotations
@@ -16,15 +20,90 @@ import optax
 from flax import struct
 
 
-def make_optimizer(name: str = "adam", learning_rate: float = 1e-3, **kw) -> optax.GradientTransformation:
+def make_schedule(
+    learning_rate: float,
+    schedule: str | None = None,
+    *,
+    warmup_steps: int = 0,
+    total_steps: int | None = None,
+    end_value: float = 0.0,
+) -> float | optax.Schedule:
+    """Learning-rate schedule: ``None``/``"constant"`` (the reference's fixed
+    lr), ``"cosine"`` (cosine decay to ``end_value`` over ``total_steps``),
+    or ``"warmup_cosine"`` (linear 0→lr over ``warmup_steps``, then cosine).
+    """
+    if schedule in (None, "constant"):
+        if warmup_steps:
+            return optax.linear_schedule(0.0, learning_rate, warmup_steps)
+        return learning_rate
+    if schedule == "cosine":
+        if total_steps is None:
+            raise ValueError("cosine schedule requires total_steps")
+        if warmup_steps:  # cosine-with-warmup IS warmup_cosine; honor it
+            schedule = "warmup_cosine"
+        else:
+            return optax.cosine_decay_schedule(
+                learning_rate, total_steps, alpha=end_value / learning_rate
+            )
+    if schedule == "warmup_cosine":
+        if total_steps is None:
+            raise ValueError("warmup_cosine schedule requires total_steps")
+        return optax.warmup_cosine_decay_schedule(
+            0.0,
+            learning_rate,
+            warmup_steps,
+            max(total_steps, warmup_steps + 1),
+            end_value=end_value,
+        )
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def make_optimizer(
+    name: str = "adam",
+    learning_rate: float | optax.Schedule = 1e-3,
+    *,
+    schedule: str | None = None,
+    warmup_steps: int = 0,
+    total_steps: int | None = None,
+    grad_clip: float | None = None,
+    accumulate_steps: int = 1,
+    **kw,
+) -> optax.GradientTransformation:
+    """Optimizer with optional schedule, clipping, and accumulation.
+
+    ``accumulate_steps=K`` wraps the chain in ``optax.MultiSteps``: K calls
+    to ``update`` average their gradients and emit one real parameter update
+    (zero updates in between), so ``fit`` needs no special handling — the
+    effective batch is K × the loader batch.
+    """
+    if isinstance(learning_rate, (int, float)):
+        lr = make_schedule(
+            learning_rate,
+            schedule,
+            warmup_steps=warmup_steps,
+            total_steps=total_steps,
+        )
+    else:
+        if schedule is not None or warmup_steps:
+            raise ValueError(
+                "learning_rate is already a schedule callable; "
+                "schedule/warmup_steps would be silently ignored"
+            )
+        lr = learning_rate
     name = name.lower()
     if name == "sgd":
-        return optax.sgd(learning_rate, **kw)
-    if name == "adam":
-        return optax.adam(learning_rate, **kw)
-    if name == "adamw":
-        return optax.adamw(learning_rate, **kw)
-    raise ValueError(f"unknown optimizer {name!r}")
+        base = optax.sgd(lr, **kw)
+    elif name == "adam":
+        base = optax.adam(lr, **kw)
+    elif name == "adamw":
+        base = optax.adamw(lr, **kw)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if grad_clip is not None:
+        base = optax.chain(optax.clip_by_global_norm(grad_clip), base)
+    if accumulate_steps > 1:
+        base = optax.MultiSteps(base, every_k_schedule=accumulate_steps)
+    return base
 
 
 class TrainState(struct.PyTreeNode):

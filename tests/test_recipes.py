@@ -70,6 +70,25 @@ class TestTranslationRecipe:
         assert out["src_vocab"] > 4 and out["trg_vocab"] > 4
         assert "test_loss" in out
 
+    def test_schedule_and_accumulation_flags(self):
+        """warmup_cosine + grad_accum + grad_clip reachable from the recipe
+        surface; the run still learns (loss below the uniform start)."""
+        out = train_translator(
+            epochs=2,
+            synthetic_n=256,
+            batch_size=8,
+            max_len=24,
+            d_model=32,
+            ffn_hidden=64,
+            num_heads=4,
+            log_every=0,
+            schedule="warmup_cosine",
+            warmup_steps=4,
+            grad_clip=1.0,
+            grad_accum=2,
+        )
+        assert out["history"][-1]["loss"] < 7.0
+
 
 class TestParallelismFlags:
     """TP/SP reachable from the recipe surface (VERDICT round-2 item 10):

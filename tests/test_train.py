@@ -118,6 +118,92 @@ class TestFitMLP:
         assert int(result.state.step) == 3
 
 
+class TestOptimizerKnobs:
+    """Schedules, clipping, accumulation — training-scale knobs the
+    reference's fixed-lr SGD/Adam lacks (SURVEY.md §2.3 headroom)."""
+
+    def test_warmup_cosine_shape(self):
+        from machine_learning_apache_spark_tpu.train.state import make_schedule
+
+        sched = make_schedule(
+            1e-3, "warmup_cosine", warmup_steps=10, total_steps=100
+        )
+        assert float(sched(0)) == 0.0
+        np.testing.assert_allclose(float(sched(10)), 1e-3, rtol=1e-6)
+        assert float(sched(50)) < 1e-3
+        assert float(sched(100)) < float(sched(50))
+
+    def test_cosine_requires_total_steps(self):
+        with pytest.raises(ValueError, match="total_steps"):
+            make_optimizer("adam", 1e-3, schedule="cosine")
+
+    def test_cosine_honors_warmup(self):
+        from machine_learning_apache_spark_tpu.train.state import make_schedule
+
+        sched = make_schedule(
+            1e-3, "cosine", warmup_steps=10, total_steps=100
+        )
+        assert float(sched(0)) == 0.0  # warmup not silently dropped
+        np.testing.assert_allclose(float(sched(10)), 1e-3, rtol=1e-6)
+
+    def test_grad_clip_caps_update(self):
+        params = {"w": jnp.zeros(4)}
+        huge = {"w": jnp.full(4, 1e6)}
+        tx = make_optimizer("sgd", 1.0, grad_clip=1.0)
+        updates, _ = tx.update(huge, tx.init(params), params)
+        norm = float(jnp.linalg.norm(updates["w"]))
+        np.testing.assert_allclose(norm, 1.0, rtol=1e-5)
+
+    def test_accumulation_matches_big_batch(self, rng):
+        """K microbatch updates under MultiSteps(K) == one SGD update on the
+        concatenated batch (grad-mean linearity)."""
+        feats, labels = _synthetic_classification(rng, n=60)
+        model = MLP(layers=(4, 5, 4, 3))
+        params = model.init(jax.random.key(0), feats[:1])["params"]
+        loss_fn = classification_loss(model.apply, train=False)
+
+        accum = TrainState.create(
+            apply_fn=model.apply, params=params,
+            tx=make_optimizer("sgd", 0.1, accumulate_steps=2),
+        )
+        big = TrainState.create(
+            apply_fn=model.apply, params=params,
+            tx=make_optimizer("sgd", 0.1),
+        )
+        rng_key = jax.random.key(1)
+        for batch in _batches(feats, labels, 30):  # two microbatches of 30
+            grads = jax.grad(lambda p: loss_fn(p, batch, rng_key)[0])(
+                accum.params
+            )
+            accum = accum.apply_gradients(grads)
+        full = (jnp.asarray(feats), jnp.asarray(labels))
+        big = big.apply_gradients(
+            jax.grad(lambda p: loss_fn(p, full, rng_key)[0])(big.params)
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6
+            ),
+            accum.params,
+            big.params,
+        )
+
+    def test_fit_with_accumulation_learns(self, rng):
+        feats, labels = _synthetic_classification(rng)
+        model = MLP(layers=(4, 5, 4, 3))
+        params = model.init(jax.random.key(0), feats[:1])["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params,
+            tx=make_optimizer("sgd", 0.03, accumulate_steps=2),
+        )
+        batches = _batches(feats, labels, 30)
+        result = fit(
+            state, classification_loss(model.apply), batches,
+            epochs=100, log_every=0,
+        )
+        assert result.history[-1]["loss"] < result.history[0]["loss"]
+
+
 class TestFitCNN:
     def test_loss_decreases(self, rng):
         # Tiny synthetic FashionMNIST-shaped batch; 20 steps of SGD(0.01).
