@@ -178,6 +178,15 @@ def train_translator(
             f"grad_accum={r.grad_accum} exceeds the run's {n_micro} "
             "microbatches; the optimizer would never update"
         )
+    if r.grad_accum > 1 and n_micro % r.grad_accum:
+        from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+        get_logger(__name__).warning(
+            "grad_accum=%d does not divide the run's %d microbatches; the "
+            "final %d gradient(s) stay in the accumulator and never update "
+            "the params",
+            r.grad_accum, n_micro, n_micro % r.grad_accum,
+        )
     total_updates = max(n_micro // max(r.grad_accum, 1), 1)
     state = TrainState.create(
         apply_fn=model.apply,

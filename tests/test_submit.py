@@ -1,0 +1,74 @@
+"""mlspark-submit: the spark-submit analogue (reference L0 submit mode) —
+conf normalization plus an end-to-end empty-builder conf read-back
+(``distributed_cnn.py:41-43``)."""
+
+import os
+import sys
+
+import pytest
+
+from machine_learning_apache_spark_tpu.submit import _conf_to_env, build_env, main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestConfMapping:
+    def test_spark_key_normalizes(self):
+        assert _conf_to_env("spark.executor.instances", "4") == (
+            "MLSPARK_EXECUTOR_INSTANCES", "4",
+        )
+
+    def test_bare_key_normalizes(self):
+        assert _conf_to_env("executor_instances", "2") == (
+            "MLSPARK_EXECUTOR_INSTANCES", "2",
+        )
+
+    def test_bad_conf_rejected(self, tmp_path):
+        script = tmp_path / "s.py"
+        script.write_text("pass")
+        with pytest.raises(SystemExit, match="key=value"):
+            main(["--conf", "no-equals-sign", str(script)])
+
+    def test_missing_script_rejected(self):
+        with pytest.raises(SystemExit, match="not found"):
+            main(["/nonexistent/driver.py"])
+
+    def test_num_processes_feeds_executor_instances(self):
+        import argparse
+
+        ns = argparse.Namespace(
+            conf=None, name=None, platform=None, coordinator="h:1234",
+            num_processes=4, process_id=1,
+        )
+        env = build_env(ns)
+        assert env["MLSPARK_NUM_PROCESSES"] == "4"
+        assert env["MLSPARK_EXECUTOR_INSTANCES"] == "4"  # conf read-back
+        assert env["MLSPARK_COORDINATOR"] == "h:1234"
+        assert env["MLSPARK_PROCESS_ID"] == "1"
+
+
+class TestSubmitEndToEnd:
+    def test_empty_builder_reads_submitted_conf(self, tmp_path, monkeypatch):
+        """The reference's submit-mode contract: the driver builds a session
+        from an EMPTY conf and reads spark.executor.instances back."""
+        out_file = tmp_path / "result.txt"
+        driver = tmp_path / "driver.py"
+        driver.write_text(
+            "import sys\n"
+            "from machine_learning_apache_spark_tpu import Session\n"
+            "s = Session.builder.getOrCreate()\n"
+            "open(sys.argv[1], 'w').write(\n"
+            "    f'{s.conf.app_name}:{s.conf.executor_instances}')\n"
+            "s.stop()\n"
+        )
+        monkeypatch.setenv(
+            "PYTHONPATH", REPO + os.pathsep + os.environ.get("PYTHONPATH", "")
+        )
+        rc = main([
+            "--conf", "spark.executor.instances=3",
+            "--name", "SubmitSmoke",
+            "--platform", "cpu",
+            str(driver), str(out_file),
+        ])
+        assert rc == 0
+        assert out_file.read_text() == "SubmitSmoke:3"
