@@ -13,6 +13,7 @@ from machine_learning_apache_spark_tpu.models.transformer import (
     beam_translate,
     greedy_translate,
     greedy_translate_cached,
+    sample_translate,
     Encoder,
     Decoder,
     TransformerConfig,
@@ -27,6 +28,7 @@ __all__ = [
     "beam_translate",
     "greedy_translate",
     "greedy_translate_cached",
+    "sample_translate",
     "Encoder",
     "Decoder",
     "TransformerConfig",

@@ -751,22 +751,24 @@ def beam_translate(
     return jnp.where(use_banked[:, None], best_ys, live_ys)
 
 
-def greedy_translate_cached(
+def _cached_decode(
     model: "Transformer",
     params,
     src_tokens: jnp.ndarray,
+    select_next,
     *,
-    max_new_tokens: int | None = None,
-    sos_id: int = 1,
-    eos_id: int = 2,
+    max_new_tokens: int | None,
+    sos_id: int,
+    eos_id: int,
 ) -> jnp.ndarray:
-    """KV-cache greedy decoding: each step runs the decoder stack on only
-    the new token, appending its self-attention K/V to a mutable cache —
-    the O(L)-per-step full re-decode of ``greedy_translate`` (self QKV +
-    FFN over the whole prefix) drops to O(1). Cross-attention K/V over the
-    encoder memory are projected once, on the cache-priming call, and
-    reused from the cache every step. Same output contract as
-    ``greedy_translate``.
+    """Shared KV-cache decode loop: encode once, prime the cache, then scan
+    one-token decoder steps; ``select_next(logits[B, V], t) -> [B] int32``
+    is the only policy difference between the greedy and sampling decoders.
+
+    Each step runs the decoder stack on only the new token, appending its
+    self-attention K/V to a mutable cache — O(1) decoder work per token vs
+    the O(L) full re-decode of ``greedy_translate``. Cross-attention K/V
+    over the encoder memory are projected once, on the priming call.
     """
     cfg = model.cfg
     pad = cfg.pad_id
@@ -802,7 +804,7 @@ def greedy_translate_cached(
             method=Transformer.decode_step,
             mutable=["cache"],
         )
-        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        nxt = select_next(logits[:, 0, :], t).astype(jnp.int32)
         nxt = jnp.where(finished, pad, nxt)
         finished = finished | (nxt == eos_id)
         ys = jax.lax.dynamic_update_index_in_dim(ys, nxt, t + 1, axis=1)
@@ -812,3 +814,84 @@ def greedy_translate_cached(
         step, (ys, finished, cache), jnp.arange(max_new_tokens)
     )
     return ys
+
+
+def greedy_translate_cached(
+    model: "Transformer",
+    params,
+    src_tokens: jnp.ndarray,
+    *,
+    max_new_tokens: int | None = None,
+    sos_id: int = 1,
+    eos_id: int = 2,
+) -> jnp.ndarray:
+    """KV-cache greedy decoding — ``_cached_decode`` with an argmax policy.
+    Same output contract as ``greedy_translate``."""
+    return _cached_decode(
+        model, params, src_tokens,
+        lambda logits, t: jnp.argmax(logits, axis=-1),
+        max_new_tokens=max_new_tokens, sos_id=sos_id, eos_id=eos_id,
+    )
+
+
+def _filter_logits(
+    logits: jnp.ndarray, temperature: float, top_k: int | None, top_p: float | None
+) -> jnp.ndarray:
+    """Sampling filters over ``[B, V]`` logits: temperature scaling, then
+    top-k truncation, then nucleus (top-p) truncation — masked-out entries
+    become NEG_INF so ``jax.random.categorical`` never selects them."""
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # Keep the smallest prefix whose mass reaches top_p (the first token
+        # always survives: its exclusive cumulative mass is 0 < top_p).
+        exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+        keep = exclusive_cum < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, NEG_INF, logits)
+    return logits
+
+
+def sample_translate(
+    model: "Transformer",
+    params,
+    src_tokens: jnp.ndarray,
+    rng: jax.Array,
+    *,
+    max_new_tokens: int | None = None,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    sos_id: int = 1,
+    eos_id: int = 2,
+) -> jnp.ndarray:
+    """Stochastic decoding with temperature / top-k / nucleus filtering —
+    ``_cached_decode`` with a filtered-categorical policy (O(1) decoder work
+    per token). ``temperature=0`` degrades to greedy argmax. Same output
+    contract as the greedy decoders: ``[B, max_new_tokens + 1]`` int32 ids,
+    ``sos``-led, rows padded after their ``eos``.
+    """
+    if temperature <= 0.0:  # static: resolved at trace time
+        select = lambda logits, t: jnp.argmax(logits, axis=-1)
+    else:
+        # Validate filter args eagerly (not at first trace inside the scan).
+        _filter_logits(jnp.zeros((1, 2)), temperature, top_k, top_p)
+
+        def select(logits, t):
+            filtered = _filter_logits(logits, temperature, top_k, top_p)
+            return jax.random.categorical(jax.random.fold_in(rng, t), filtered)
+
+    return _cached_decode(
+        model, params, src_tokens, select,
+        max_new_tokens=max_new_tokens, sos_id=sos_id, eos_id=eos_id,
+    )

@@ -77,6 +77,10 @@ class TranslationRecipe:
     warmup_steps: int = 0
     grad_clip: float | None = None
     grad_accum: int = 1
+    # Decode the validation set after training and report corpus BLEU — the
+    # MT quality metric the reference never computes (loss only,
+    # ``pytorch_machine_translator.py:189``).
+    compute_bleu: bool = False
 
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
@@ -231,11 +235,41 @@ def train_translator(
             val_loader,
             mesh=mesh,
         )
+    extra: dict = {}
+    if r.compute_bleu and val_loader is not None:
+        from machine_learning_apache_spark_tpu.data.text import EOS_ID, SOS_ID
+        from machine_learning_apache_spark_tpu.models.transformer import (
+            greedy_translate_cached,
+        )
+        from machine_learning_apache_spark_tpu.train.metrics import (
+            corpus_bleu,
+            strip_special_ids,
+        )
+
+        # One jitted decode, reusing the eval loader's batching (including
+        # its ragged tail — one extra compile, zero skipped rows). Target
+        # width is the pipeline's fixed length, so gen length is static.
+        gen = min(val_ds[:1][1].shape[1], r.max_len) - 1
+        decode = jax.jit(
+            lambda params, src: greedy_translate_cached(
+                model, params, src,
+                max_new_tokens=gen, sos_id=SOS_ID, eos_id=EOS_ID,
+            )
+        )
+        kw = dict(pad_id=cfg.pad_id, sos_id=SOS_ID, eos_id=EOS_ID)
+        cands: list[list[int]] = []
+        refs: list[list[int]] = []
+        for src_b, trg_b in val_loader:
+            cands.extend(strip_special_ids(decode(result.state.params, src_b), **kw))
+            refs.extend(strip_special_ids(trg_b, **kw))
+        extra["bleu"] = corpus_bleu(cands, refs)
+
     out = summarize(
         result,
         metrics,
         src_vocab=len(src_pipe.vocab),
         trg_vocab=len(trg_pipe.vocab),
+        **extra,
     )
     if _return_state:
         # Test/inspection hook — the state is NOT picklable across the

@@ -27,6 +27,81 @@ def logits_accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return accuracy(labels, jnp.argmax(logits, axis=-1))
 
 
+def strip_special_ids(
+    ids, *, pad_id: int = 0, sos_id: int = 1, eos_id: int = 2
+) -> list[list[int]]:
+    """Decoder output rows → clean token-id lists: drop the leading ``sos``,
+    cut at the first ``eos``, drop pads — the form BLEU scores."""
+    import numpy as np
+
+    out = []
+    for row in np.asarray(ids):
+        toks = [int(t) for t in row]
+        if toks and toks[0] == sos_id:
+            toks = toks[1:]
+        if eos_id in toks:
+            toks = toks[: toks.index(eos_id)]
+        out.append([t for t in toks if t != pad_id])
+    return out
+
+
+def corpus_bleu(
+    candidates: list[list[int]],
+    references: list[list[int]],
+    *,
+    max_n: int = 4,
+    smooth: bool = True,
+) -> float:
+    """Corpus BLEU over token-id sequences (Papineni et al. 2002): clipped
+    modified n-gram precisions (n ≤ ``max_n``) geometric-mean'd with a
+    brevity penalty — the standard MT quality metric the reference's
+    translation driver never computes (it reports loss only,
+    ``pytorch_machine_translator.py:189``). Host-side, pure Python.
+
+    ``smooth=True`` applies add-one smoothing (Lin & Och 2004 method 1 style)
+    to zero higher-order counts so short corpora don't collapse to 0.
+    """
+    from collections import Counter
+    from math import exp, log
+
+    if len(candidates) != len(references):
+        raise ValueError(
+            f"{len(candidates)} candidates vs {len(references)} references"
+        )
+    if not candidates:
+        return 0.0
+
+    def ngrams(seq, n):
+        return Counter(tuple(seq[i : i + n]) for i in range(len(seq) - n + 1))
+
+    matched = [0] * max_n
+    total = [0] * max_n
+    cand_len = ref_len = 0
+    for cand, ref in zip(candidates, references):
+        cand_len += len(cand)
+        ref_len += len(ref)
+        for n in range(1, max_n + 1):
+            cn, rn = ngrams(cand, n), ngrams(ref, n)
+            total[n - 1] += max(len(cand) - n + 1, 0)
+            matched[n - 1] += sum(min(c, rn[g]) for g, c in cn.items())
+    precisions = []
+    for m, t in zip(matched, total):
+        if t == 0:
+            precisions.append(None)  # no n-grams that long anywhere; skip
+        elif m == 0:
+            if not smooth:
+                return 0.0
+            precisions.append(1.0 / (2.0 * t))
+        else:
+            precisions.append(m / t)
+    precisions = [p for p in precisions if p is not None]
+    if not precisions:
+        return 0.0
+    geo = exp(sum(log(p) for p in precisions) / len(precisions))
+    bp = 1.0 if cand_len > ref_len else exp(1.0 - ref_len / max(cand_len, 1))
+    return bp * geo
+
+
 @dataclass
 class Sum:
     """Running sum — ``total_train_loss += loss`` (``pytorch_cnn.py:131``)."""

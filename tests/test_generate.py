@@ -175,6 +175,172 @@ class TestCachedDecoder:
             greedy_translate_cached(model, params, src, max_new_tokens=8)
 
 
+class TestSampling:
+    """sample_translate: temperature / top-k / nucleus decoding over the same
+    KV-cache step as the greedy decoder."""
+
+    def _setup(self, seed=3, b=3):
+        model = tiny_model(max_len=16)
+        src = jnp.asarray(
+            np.random.default_rng(seed).integers(4, 60, (b, 10)), jnp.int32
+        )
+        params = model.init(
+            jax.random.key(1), src, jnp.ones((b, 8), jnp.int32)
+        )["params"]
+        return model, params, src
+
+    def test_temperature_zero_equals_greedy(self):
+        from machine_learning_apache_spark_tpu.models import sample_translate
+
+        model, params, src = self._setup()
+        greedy = greedy_translate_cached(model, params, src, max_new_tokens=12)
+        sampled = sample_translate(
+            model, params, src, jax.random.key(0),
+            max_new_tokens=12, temperature=0.0,
+        )
+        np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+    def test_top_k_1_equals_greedy(self):
+        from machine_learning_apache_spark_tpu.models import sample_translate
+
+        model, params, src = self._setup()
+        greedy = greedy_translate_cached(model, params, src, max_new_tokens=12)
+        sampled = sample_translate(
+            model, params, src, jax.random.key(0),
+            max_new_tokens=12, temperature=1.0, top_k=1,
+        )
+        np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+    def test_contract_and_determinism_per_key(self):
+        from machine_learning_apache_spark_tpu.models import sample_translate
+
+        model, params, src = self._setup()
+        a = sample_translate(
+            model, params, src, jax.random.key(7),
+            max_new_tokens=12, temperature=1.0, top_p=0.9,
+        )
+        b = sample_translate(
+            model, params, src, jax.random.key(7),
+            max_new_tokens=12, temperature=1.0, top_p=0.9,
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        out = np.asarray(a)
+        assert out.shape == (3, 13)
+        assert (out[:, 0] == SOS_ID).all()
+        assert (out < model.cfg.trg_vocab_size).all() and (out >= 0).all()
+        for row in out:
+            eos_pos = np.flatnonzero(row == EOS_ID)
+            if eos_pos.size:
+                assert (row[eos_pos[0] + 1 :] == PAD_ID).all()
+
+    def test_filter_logits_top_k_top_p(self):
+        from machine_learning_apache_spark_tpu.models.transformer import (
+            _filter_logits,
+        )
+        from machine_learning_apache_spark_tpu.ops.attention import NEG_INF
+
+        logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0, -1.0]])
+        k2 = np.asarray(_filter_logits(logits, 1.0, 2, None))
+        assert (k2[0, 2:] <= NEG_INF / 2).all()
+        assert k2[0, 0] == 3.0 and k2[0, 1] == 2.0
+        # top_p: softmax([3,2,1,0,-1]) ≈ [.64,.24,.09,.03,.01];
+        # exclusive cum [.0,.64,.87,.96,.99] → p=0.7 keeps the first two.
+        p = np.asarray(_filter_logits(logits, 1.0, None, 0.7))
+        assert (p[0, 2:] <= NEG_INF / 2).all()
+        assert p[0, 0] == 3.0 and p[0, 1] == 2.0
+        # p→tiny still keeps the argmax
+        tiny = np.asarray(_filter_logits(logits, 1.0, None, 1e-6))
+        assert tiny[0, 0] == 3.0
+        assert (tiny[0, 1:] <= NEG_INF / 2).all()
+
+    def test_validation(self):
+        import pytest
+
+        from machine_learning_apache_spark_tpu.models import sample_translate
+
+        model, params, src = self._setup(b=1)
+        with pytest.raises(ValueError, match="top_k"):
+            sample_translate(
+                model, params, src, jax.random.key(0), top_k=0,
+                max_new_tokens=4,
+            )
+        with pytest.raises(ValueError, match="top_p"):
+            sample_translate(
+                model, params, src, jax.random.key(0), top_p=1.5,
+                max_new_tokens=4,
+            )
+
+
+class TestBleu:
+    """corpus_bleu + strip_special_ids — the MT quality metric the reference
+    never computes (loss only, ``pytorch_machine_translator.py:189``)."""
+
+    def test_perfect_match_is_one(self):
+        from machine_learning_apache_spark_tpu.train.metrics import corpus_bleu
+
+        seqs = [[5, 6, 7, 8, 9], [4, 4, 5, 6, 7, 8]]
+        assert corpus_bleu(seqs, seqs) == 1.0
+
+    def test_known_value(self):
+        from machine_learning_apache_spark_tpu.train.metrics import corpus_bleu
+
+        # cand/ref share 3/4 unigrams, 2/3 bigrams, 1/2 trigrams, 0/1 4-grams
+        cand = [[1, 2, 3, 9]]
+        ref = [[1, 2, 3, 4]]
+        # smoothed p4 = 1/(2*1); geometric mean of [3/4, 2/3, 1/2, 1/2]
+        import math
+
+        expected = math.exp(
+            (math.log(3 / 4) + math.log(2 / 3) + math.log(1 / 2)
+             + math.log(1 / 2)) / 4
+        )
+        np.testing.assert_allclose(
+            corpus_bleu(cand, ref), expected, rtol=1e-9
+        )
+
+    def test_brevity_penalty(self):
+        from machine_learning_apache_spark_tpu.train.metrics import corpus_bleu
+
+        # candidate is a perfect prefix but half the reference length
+        cand = [[1, 2, 3]]
+        ref = [[1, 2, 3, 4, 5, 6]]
+        score = corpus_bleu(cand, ref, max_n=2, smooth=False)
+        import math
+
+        assert score <= math.exp(1 - 6 / 3) + 1e-9
+
+    def test_mismatched_lengths_raise(self):
+        import pytest
+
+        from machine_learning_apache_spark_tpu.train.metrics import corpus_bleu
+
+        with pytest.raises(ValueError):
+            corpus_bleu([[1]], [[1], [2]])
+
+    def test_strip_special_ids(self):
+        from machine_learning_apache_spark_tpu.train.metrics import (
+            strip_special_ids,
+        )
+
+        rows = np.asarray([
+            [SOS_ID, 5, 6, EOS_ID, PAD_ID, PAD_ID],
+            [SOS_ID, 7, PAD_ID, 8, PAD_ID, PAD_ID],  # no eos: pads dropped
+        ])
+        assert strip_special_ids(rows) == [[5, 6], [7, 8]]
+
+    def test_recipe_reports_bleu(self):
+        from machine_learning_apache_spark_tpu.recipes.translation import (
+            train_translator,
+        )
+
+        out = train_translator(
+            epochs=1, synthetic_n=128, batch_size=8, max_len=16,
+            d_model=32, ffn_hidden=64, num_heads=4, log_every=0,
+            compute_bleu=True,
+        )
+        assert 0.0 <= out["bleu"] <= 1.0
+
+
 class TestBeamSearch:
     """beam_translate: flat-batched KV-cache beam search (beyond-reference
     inference; the reference ships no decoding at all)."""
