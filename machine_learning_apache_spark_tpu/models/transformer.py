@@ -844,7 +844,9 @@ def _filter_logits(
     if top_k is not None:
         if top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        # top_k >= vocab keeps everything (not an error — mirrors the
+        # temperature-only case).
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
         logits = jnp.where(logits < kth, NEG_INF, logits)
     if top_p is not None:
         if not 0.0 < top_p <= 1.0:
@@ -881,12 +883,15 @@ def sample_translate(
     contract as the greedy decoders: ``[B, max_new_tokens + 1]`` int32 ids,
     ``sos``-led, rows padded after their ``eos``.
     """
+    # Validate filter args eagerly and uniformly (the greedy temperature=0
+    # branch must reject bad top_k/top_p exactly like the sampling branch).
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if temperature <= 0.0:  # static: resolved at trace time
         select = lambda logits, t: jnp.argmax(logits, axis=-1)
     else:
-        # Validate filter args eagerly (not at first trace inside the scan).
-        _filter_logits(jnp.zeros((1, 2)), temperature, top_k, top_p)
-
         def select(logits, t):
             filtered = _filter_logits(logits, temperature, top_k, top_p)
             return jax.random.categorical(jax.random.fold_in(rng, t), filtered)

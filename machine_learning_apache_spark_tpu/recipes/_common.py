@@ -178,6 +178,50 @@ def make_loaders(
     return train_loader, test_loader
 
 
+def open_checkpointing(
+    checkpoint_dir: str | None,
+    state,
+    *,
+    resume: bool = True,
+    max_to_keep: int = 3,
+):
+    """Recipe-surface checkpoint/resume (persistence the reference lacks
+    entirely — SURVEY.md §5 checkpoint/resume).
+
+    Returns ``(manager_or_None, state, resumed_step_or_None)``: when
+    ``checkpoint_dir`` holds prior checkpoints and ``resume`` is True, the
+    freshly-created ``state`` acts as the restore template (same
+    model/optimizer code) and training continues from the latest step.
+    Callers pass the manager to ``fit(checkpointer=...)`` and must ``close()``
+    it (or use it as a context manager) when done.
+    """
+    if not checkpoint_dir:
+        return None, state, None
+    from machine_learning_apache_spark_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+
+    mgr = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
+    resumed = None
+    if resume and mgr.latest_step() is not None:
+        # fit() saves the UNBOXED state (shard_state strips the Flax
+        # Partitioned boxes), so restore against an unboxed template, then
+        # graft the restored values back into the boxed structure — the
+        # logical-axis annotations must survive resume or a TP mesh would
+        # silently replicate the restored weights.
+        import flax.linen as nn
+        from flax.core import meta
+
+        restored, resumed = mgr.restore(nn.unbox(state))
+        is_box = lambda x: isinstance(x, meta.AxisMetadata)
+        state = jax.tree.map(
+            lambda box, val: box.replace_boxed(val) if is_box(box) else val,
+            state, restored, is_leaf=is_box,
+        )
+        log.info("resuming from checkpoint step %d", resumed)
+    return mgr, state, resumed
+
+
 def summarize(fit_result, eval_metrics: dict | None, **extra) -> dict:
     """The printable/picklable end-of-run contract — the reference's metric
     vocabulary (SURVEY.md §5: train wall-time, losses, accuracy %)."""

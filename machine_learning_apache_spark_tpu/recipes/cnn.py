@@ -28,6 +28,7 @@ from machine_learning_apache_spark_tpu.train.loop import (
 from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
 from machine_learning_apache_spark_tpu.recipes._common import (
     make_loaders,
+    open_checkpointing,
     with_overrides,
     resolve_mesh,
     summarize,
@@ -49,6 +50,12 @@ class CNNRecipe:
     synthetic_n: int = 4096
     use_mesh: bool = True
     log_every: int = 0
+    # Checkpoint/resume (persistence the reference lacks, SURVEY.md §5):
+    # save every checkpoint_every epochs under checkpoint_dir; when the dir
+    # already holds checkpoints and resume=True, continue from the latest.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
+    resume: bool = True
 
 
 def train_cnn(recipe: CNNRecipe | None = None, **overrides) -> dict:
@@ -81,19 +88,29 @@ def train_cnn(recipe: CNNRecipe | None = None, **overrides) -> dict:
         tx=make_optimizer("sgd", r.learning_rate),
     )
 
-    result = fit(
-        state,
-        classification_loss(model.apply),
-        train_loader,
-        epochs=r.epochs,
-        rng=jax.random.key(r.seed),
-        mesh=mesh,
-        log_every=r.log_every,
+    ckpt, state, resumed = open_checkpointing(
+        r.checkpoint_dir, state, resume=r.resume
     )
+    try:
+        result = fit(
+            state,
+            classification_loss(model.apply),
+            train_loader,
+            epochs=r.epochs,
+            rng=jax.random.key(r.seed),
+            mesh=mesh,
+            log_every=r.log_every,
+            checkpointer=ckpt,
+            checkpoint_every=r.checkpoint_every,
+        )
+    finally:
+        if ckpt is not None:
+            ckpt.close()
     metrics = evaluate(
         result.state,
         classification_loss(model.apply, train=False),
         test_loader,
         mesh=mesh,
     )
-    return summarize(result, metrics)
+    extra = {"resumed_from_step": resumed} if resumed is not None else {}
+    return summarize(result, metrics, **extra)

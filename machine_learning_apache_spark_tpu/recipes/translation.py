@@ -31,6 +31,7 @@ from machine_learning_apache_spark_tpu.train.losses import masked_token_cross_en
 from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
 from machine_learning_apache_spark_tpu.recipes._common import (
     make_loaders,
+    open_checkpointing,
     with_overrides,
     resolve_mesh,
     summarize,
@@ -81,6 +82,11 @@ class TranslationRecipe:
     # MT quality metric the reference never computes (loss only,
     # ``pytorch_machine_translator.py:189``).
     compute_bleu: bool = False
+    # Checkpoint/resume (SURVEY.md §5): save every checkpoint_every epochs
+    # under checkpoint_dir; resume from the latest checkpoint when present.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
+    resume: bool = True
 
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
@@ -219,16 +225,45 @@ def train_translator(
         if mesh is not None and r.sequence_parallel > 1
         else contextlib.nullcontext()
     )
-    with sp_ctx:
-        result = fit(
-            state,
-            make_translation_loss(model, cfg.pad_id),
-            train_loader,
-            epochs=r.epochs,
-            rng=jax.random.key(r.seed),
-            mesh=mesh,
-            log_every=r.log_every,
+    ckpt, state, resumed = open_checkpointing(
+        r.checkpoint_dir, state, resume=r.resume
+    )
+    if resumed and r.schedule in ("cosine", "warmup_cosine"):
+        # The restored optimizer count sits at the prior run's update total;
+        # a schedule whose horizon was sized for a fresh run would evaluate
+        # at/past its end and train the whole resumed run at the decayed
+        # floor LR. Extend the horizon by the restored update count (the
+        # step counter counts microbatches; updates are 1/grad_accum of
+        # those) so training continues mid-curve. The opt_state STRUCTURE
+        # is unchanged — only the lr curve differs.
+        prior_updates = resumed // max(r.grad_accum, 1)
+        state = state.replace(
+            tx=make_optimizer(
+                "adam",
+                r.learning_rate,
+                schedule=r.schedule,
+                warmup_steps=r.warmup_steps,
+                total_steps=prior_updates + total_updates,
+                grad_clip=r.grad_clip,
+                accumulate_steps=r.grad_accum,
+            )
         )
+    with sp_ctx:
+        try:
+            result = fit(
+                state,
+                make_translation_loss(model, cfg.pad_id),
+                train_loader,
+                epochs=r.epochs,
+                rng=jax.random.key(r.seed),
+                mesh=mesh,
+                log_every=r.log_every,
+                checkpointer=ckpt,
+                checkpoint_every=r.checkpoint_every,
+            )
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         metrics = evaluate(
             result.state,
             make_translation_loss(model, cfg.pad_id, train=False),
@@ -236,6 +271,8 @@ def train_translator(
             mesh=mesh,
         )
     extra: dict = {}
+    if resumed is not None:
+        extra["resumed_from_step"] = resumed
     if r.compute_bleu and val_loader is not None:
         from machine_learning_apache_spark_tpu.data.text import EOS_ID, SOS_ID
         from machine_learning_apache_spark_tpu.models.transformer import (

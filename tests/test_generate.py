@@ -269,6 +269,18 @@ class TestSampling:
                 model, params, src, jax.random.key(0), top_p=1.5,
                 max_new_tokens=4,
             )
+        # greedy mode (temperature=0) rejects bad filter args identically
+        with pytest.raises(ValueError, match="top_k"):
+            sample_translate(
+                model, params, src, jax.random.key(0), temperature=0.0,
+                top_k=0, max_new_tokens=4,
+            )
+        # top_k >= vocab is a no-op filter, not an error
+        out = sample_translate(
+            model, params, src, jax.random.key(0),
+            top_k=10 * model.cfg.trg_vocab_size, max_new_tokens=4,
+        )
+        assert out.shape == (1, 5)
 
 
 class TestBleu:
