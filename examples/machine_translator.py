@@ -18,9 +18,11 @@ from machine_learning_apache_spark_tpu.recipes import train_translator
 
 out = train_translator(
     data_root=sys.argv[1] if len(sys.argv) > 1 else None,
+    compute_bleu=True,
 )
 
 print(f"Training Time: {out['train_seconds']:.3f} sec")
 print(f"src/trg vocab: {out['src_vocab']}/{out['trg_vocab']}")
 print(f"Final train loss: {out['final_loss']:.5f}")
 print(f"Validation loss: {out['test_loss']:.5f}")
+print(f"Validation BLEU: {out['bleu']:.4f}")

@@ -178,6 +178,31 @@ def make_loaders(
     return train_loader, test_loader
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def checkpointing(
+    checkpoint_dir: str | None,
+    state,
+    *,
+    resume: bool = True,
+    max_to_keep: int = 3,
+):
+    """Context-managed recipe checkpointing: yields
+    ``(manager_or_None, state, resumed_step_or_None)`` and closes the
+    manager on exit — the shared shape of every recipe's
+    open → fit(checkpointer=...) → close sequence."""
+    mgr, state, resumed = open_checkpointing(
+        checkpoint_dir, state, resume=resume, max_to_keep=max_to_keep
+    )
+    try:
+        yield mgr, state, resumed
+    finally:
+        if mgr is not None:
+            mgr.close()
+
+
 def open_checkpointing(
     checkpoint_dir: str | None,
     state,
@@ -193,7 +218,7 @@ def open_checkpointing(
     freshly-created ``state`` acts as the restore template (same
     model/optimizer code) and training continues from the latest step.
     Callers pass the manager to ``fit(checkpointer=...)`` and must ``close()``
-    it (or use it as a context manager) when done.
+    it when done — or use the ``checkpointing`` context manager, which does.
     """
     if not checkpoint_dir:
         return None, state, None

@@ -27,8 +27,8 @@ from machine_learning_apache_spark_tpu.train.loop import (
 )
 from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
 from machine_learning_apache_spark_tpu.recipes._common import (
+    checkpointing,
     make_loaders,
-    open_checkpointing,
     with_overrides,
     resolve_mesh,
     summarize,
@@ -88,10 +88,9 @@ def train_cnn(recipe: CNNRecipe | None = None, **overrides) -> dict:
         tx=make_optimizer("sgd", r.learning_rate),
     )
 
-    ckpt, state, resumed = open_checkpointing(
+    with checkpointing(
         r.checkpoint_dir, state, resume=r.resume
-    )
-    try:
+    ) as (ckpt, state, resumed):
         result = fit(
             state,
             classification_loss(model.apply),
@@ -103,9 +102,6 @@ def train_cnn(recipe: CNNRecipe | None = None, **overrides) -> dict:
             checkpointer=ckpt,
             checkpoint_every=r.checkpoint_every,
         )
-    finally:
-        if ckpt is not None:
-            ckpt.close()
     metrics = evaluate(
         result.state,
         classification_loss(model.apply, train=False),

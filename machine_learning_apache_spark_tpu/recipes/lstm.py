@@ -30,6 +30,7 @@ from machine_learning_apache_spark_tpu.train.loop import (
 )
 from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
 from machine_learning_apache_spark_tpu.recipes._common import (
+    checkpointing,
     make_loaders,
     with_overrides,
     resolve_mesh,
@@ -56,6 +57,11 @@ class LSTMRecipe:
     synthetic_n: int = 2048
     use_mesh: bool = True
     log_every: int = 0
+    # Checkpoint/resume (SURVEY.md §5): save every checkpoint_every epochs
+    # under checkpoint_dir; resume from the latest checkpoint when present.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
+    resume: bool = True
 
 
 def train_lstm(recipe: LSTMRecipe | None = None, **overrides) -> dict:
@@ -103,19 +109,25 @@ def train_lstm(recipe: LSTMRecipe | None = None, **overrides) -> dict:
 
     # Loss on the final timestep's logits — pred[:, -1, :]
     # (``pytorch_lstm.py:160``).
-    result = fit(
-        state,
-        classification_loss(model.apply, last_timestep=True),
-        train_loader,
-        epochs=r.epochs,
-        rng=jax.random.key(r.seed),
-        mesh=mesh,
-        log_every=r.log_every,
-    )
+    with checkpointing(
+        r.checkpoint_dir, state, resume=r.resume
+    ) as (ckpt, state, resumed):
+        result = fit(
+            state,
+            classification_loss(model.apply, last_timestep=True),
+            train_loader,
+            epochs=r.epochs,
+            rng=jax.random.key(r.seed),
+            mesh=mesh,
+            log_every=r.log_every,
+            checkpointer=ckpt,
+            checkpoint_every=r.checkpoint_every,
+        )
     metrics = evaluate(
         result.state,
         classification_loss(model.apply, last_timestep=True, train=False),
         test_loader,
         mesh=mesh,
     )
-    return summarize(result, metrics, vocab_size=len(pipe.vocab))
+    extra = {"resumed_from_step": resumed} if resumed is not None else {}
+    return summarize(result, metrics, vocab_size=len(pipe.vocab), **extra)

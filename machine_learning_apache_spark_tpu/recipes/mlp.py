@@ -27,6 +27,7 @@ from machine_learning_apache_spark_tpu.train.loop import (
 )
 from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
 from machine_learning_apache_spark_tpu.recipes._common import (
+    checkpointing,
     make_loaders,
     with_overrides,
     resolve_mesh,
@@ -49,6 +50,11 @@ class MLPRecipe:
     synthetic_n: int = 600
     use_mesh: bool = True
     log_every: int = 0  # the reference prints per-batch; default quiet
+    # Checkpoint/resume (SURVEY.md §5): save every checkpoint_every epochs
+    # under checkpoint_dir; resume from the latest checkpoint when present.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
+    resume: bool = True
 
 
 def train_mlp(recipe: MLPRecipe | None = None, **overrides) -> dict:
@@ -84,19 +90,25 @@ def train_mlp(recipe: MLPRecipe | None = None, **overrides) -> dict:
         tx=make_optimizer("sgd", r.learning_rate),
     )
 
-    result = fit(
-        state,
-        classification_loss(model.apply),
-        train_loader,
-        epochs=r.epochs,
-        rng=jax.random.key(r.seed),
-        mesh=mesh,
-        log_every=r.log_every,
-    )
+    with checkpointing(
+        r.checkpoint_dir, state, resume=r.resume
+    ) as (ckpt, state, resumed):
+        result = fit(
+            state,
+            classification_loss(model.apply),
+            train_loader,
+            epochs=r.epochs,
+            rng=jax.random.key(r.seed),
+            mesh=mesh,
+            log_every=r.log_every,
+            checkpointer=ckpt,
+            checkpoint_every=r.checkpoint_every,
+        )
     metrics = evaluate(
         result.state,
         classification_loss(model.apply, train=False),
         test_loader,
         mesh=mesh,
     )
-    return summarize(result, metrics)
+    extra = {"resumed_from_step": resumed} if resumed is not None else {}
+    return summarize(result, metrics, **extra)

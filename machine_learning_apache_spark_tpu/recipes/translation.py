@@ -30,8 +30,8 @@ from machine_learning_apache_spark_tpu.train.loop import evaluate, fit
 from machine_learning_apache_spark_tpu.train.losses import masked_token_cross_entropy
 from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
 from machine_learning_apache_spark_tpu.recipes._common import (
+    checkpointing,
     make_loaders,
-    open_checkpointing,
     with_overrides,
     resolve_mesh,
     summarize,
@@ -225,31 +225,31 @@ def train_translator(
         if mesh is not None and r.sequence_parallel > 1
         else contextlib.nullcontext()
     )
-    ckpt, state, resumed = open_checkpointing(
+    with checkpointing(
         r.checkpoint_dir, state, resume=r.resume
-    )
-    if resumed and r.schedule in ("cosine", "warmup_cosine"):
-        # The restored optimizer count sits at the prior run's update total;
-        # a schedule whose horizon was sized for a fresh run would evaluate
-        # at/past its end and train the whole resumed run at the decayed
-        # floor LR. Extend the horizon by the restored update count (the
-        # step counter counts microbatches; updates are 1/grad_accum of
-        # those) so training continues mid-curve. The opt_state STRUCTURE
-        # is unchanged — only the lr curve differs.
-        prior_updates = resumed // max(r.grad_accum, 1)
-        state = state.replace(
-            tx=make_optimizer(
-                "adam",
-                r.learning_rate,
-                schedule=r.schedule,
-                warmup_steps=r.warmup_steps,
-                total_steps=prior_updates + total_updates,
-                grad_clip=r.grad_clip,
-                accumulate_steps=r.grad_accum,
+    ) as (ckpt, state, resumed):
+        if resumed and r.schedule in ("cosine", "warmup_cosine"):
+            # The restored optimizer count sits at the prior run's update
+            # total; a schedule whose horizon was sized for a fresh run
+            # would evaluate at/past its end and train the whole resumed
+            # run at the decayed floor LR. Extend the horizon by the
+            # restored update count (the step counter counts microbatches;
+            # updates are 1/grad_accum of those) so training continues
+            # mid-curve. The opt_state STRUCTURE is unchanged — only the
+            # lr curve differs.
+            prior_updates = resumed // max(r.grad_accum, 1)
+            state = state.replace(
+                tx=make_optimizer(
+                    "adam",
+                    r.learning_rate,
+                    schedule=r.schedule,
+                    warmup_steps=r.warmup_steps,
+                    total_steps=prior_updates + total_updates,
+                    grad_clip=r.grad_clip,
+                    accumulate_steps=r.grad_accum,
+                )
             )
-        )
-    with sp_ctx:
-        try:
+        with sp_ctx:
             result = fit(
                 state,
                 make_translation_loss(model, cfg.pad_id),
@@ -261,15 +261,12 @@ def train_translator(
                 checkpointer=ckpt,
                 checkpoint_every=r.checkpoint_every,
             )
-        finally:
-            if ckpt is not None:
-                ckpt.close()
-        metrics = evaluate(
-            result.state,
-            make_translation_loss(model, cfg.pad_id, train=False),
-            val_loader,
-            mesh=mesh,
-        )
+            metrics = evaluate(
+                result.state,
+                make_translation_loss(model, cfg.pad_id, train=False),
+                val_loader,
+                mesh=mesh,
+            )
     extra: dict = {}
     if resumed is not None:
         extra["resumed_from_step"] = resumed

@@ -181,6 +181,21 @@ class TestRecipeResume:
         second = train_cnn(**kw)
         assert second["resumed_from_step"] > 0
 
+    def test_mlp_and_lstm_recipes_resume(self, tmp_path):
+        from machine_learning_apache_spark_tpu.recipes.lstm import train_lstm
+        from machine_learning_apache_spark_tpu.recipes.mlp import train_mlp
+
+        kw = dict(epochs=3, synthetic_n=120, checkpoint_dir=str(tmp_path / "m"))
+        assert "resumed_from_step" not in train_mlp(**kw)
+        assert train_mlp(**kw)["resumed_from_step"] > 0
+
+        kw = dict(
+            epochs=1, synthetic_n=128, batch_size=16, max_seq_len=16,
+            checkpoint_dir=str(tmp_path / "l"),
+        )
+        assert "resumed_from_step" not in train_lstm(**kw)
+        assert train_lstm(**kw)["resumed_from_step"] > 0
+
     def test_translation_recipe_resumes(self, tmp_path):
         from machine_learning_apache_spark_tpu.recipes.translation import (
             train_translator,
