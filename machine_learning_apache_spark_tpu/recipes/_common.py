@@ -10,6 +10,7 @@ mesh/world bring-up, the fit/evaluate calls, and a **picklable** result dict
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Callable
 
 import jax
@@ -176,9 +177,6 @@ def make_loaders(
             collate=collate,
         )
     return train_loader, test_loader
-
-
-import contextlib
 
 
 @contextlib.contextmanager

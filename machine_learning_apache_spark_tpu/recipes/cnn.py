@@ -56,6 +56,9 @@ class CNNRecipe:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1
     resume: bool = True
+    # Structured observability: append per-epoch + end-of-run JSON lines
+    # (train.metrics.MetricsLogger) alongside the print vocabulary.
+    metrics_path: str | None = None
 
 
 def train_cnn(recipe: CNNRecipe | None = None, **overrides) -> dict:
@@ -101,6 +104,7 @@ def train_cnn(recipe: CNNRecipe | None = None, **overrides) -> dict:
             log_every=r.log_every,
             checkpointer=ckpt,
             checkpoint_every=r.checkpoint_every,
+            metrics_file=r.metrics_path,
         )
     metrics = evaluate(
         result.state,

@@ -62,6 +62,9 @@ class LSTMRecipe:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1
     resume: bool = True
+    # Structured observability: append per-epoch + end-of-run JSON lines
+    # (train.metrics.MetricsLogger) alongside the print vocabulary.
+    metrics_path: str | None = None
 
 
 def train_lstm(recipe: LSTMRecipe | None = None, **overrides) -> dict:
@@ -122,6 +125,7 @@ def train_lstm(recipe: LSTMRecipe | None = None, **overrides) -> dict:
             log_every=r.log_every,
             checkpointer=ckpt,
             checkpoint_every=r.checkpoint_every,
+            metrics_file=r.metrics_path,
         )
     metrics = evaluate(
         result.state,

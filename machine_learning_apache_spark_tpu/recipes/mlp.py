@@ -55,6 +55,9 @@ class MLPRecipe:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1
     resume: bool = True
+    # Structured observability: append per-epoch + end-of-run JSON lines
+    # (train.metrics.MetricsLogger) alongside the print vocabulary.
+    metrics_path: str | None = None
 
 
 def train_mlp(recipe: MLPRecipe | None = None, **overrides) -> dict:
@@ -103,6 +106,7 @@ def train_mlp(recipe: MLPRecipe | None = None, **overrides) -> dict:
             log_every=r.log_every,
             checkpointer=ckpt,
             checkpoint_every=r.checkpoint_every,
+            metrics_file=r.metrics_path,
         )
     metrics = evaluate(
         result.state,

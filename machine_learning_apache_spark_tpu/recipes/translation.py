@@ -87,6 +87,9 @@ class TranslationRecipe:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1
     resume: bool = True
+    # Structured observability: append per-epoch + end-of-run JSON lines
+    # (train.metrics.MetricsLogger) alongside the print vocabulary.
+    metrics_path: str | None = None
 
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
@@ -260,6 +263,7 @@ def train_translator(
                 log_every=r.log_every,
                 checkpointer=ckpt,
                 checkpoint_every=r.checkpoint_every,
+                metrics_file=r.metrics_path,
             )
             metrics = evaluate(
                 result.state,

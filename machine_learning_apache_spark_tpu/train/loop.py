@@ -85,6 +85,7 @@ def fit(
     checkpoint_every: int = 1,
     profile_dir: str | None = None,
     profile_window: tuple[int, int] = (2, 5),
+    metrics_file: str | None = None,
 ) -> FitResult:
     """The canonical loop (``pytorch_cnn.py:125-146`` shape): epochs × batches,
     per-``log_every``-batch loss/time prints
@@ -103,6 +104,10 @@ def fit(
     window ``profile_window`` (skipping compile/warmup steps) — the tracing
     subsystem the reference approximates with ``time.time()`` pairs
     (SURVEY.md §5).
+
+    ``metrics_file`` appends one JSON line per epoch (and a final run
+    record) — the structured counterpart of the reference's print-only
+    metrics (SURVEY.md §5 metrics/logging).
 
     The input ``state``'s buffers are CONSUMED (the fused step donates them
     for in-place updates); use ``FitResult.state``, never the argument,
@@ -126,31 +131,46 @@ def fit(
 
         state = shard_state(state, mesh)
 
+    from machine_learning_apache_spark_tpu.train.metrics import MetricsLogger
+
+    sink = MetricsLogger(metrics_file) if metrics_file else None
     total_timer = Timer("train").start()
     span_timer = Timer("span").start()
     try:
-        state, history = _run_epochs(
-            state, step_fn, train_loader, epochs, rng, mesh, log_every, emit,
-            tracer, checkpointer, checkpoint_every, span_timer,
-        )
+        try:
+            state, history = _run_epochs(
+                state, step_fn, train_loader, epochs, rng, mesh, log_every,
+                emit, tracer, checkpointer, checkpoint_every, span_timer, sink,
+            )
+        finally:
+            # An exception mid-window must still stop the (process-global)
+            # jax profiler, or every later trace in this process fails to
+            # start.
+            tracer.close()
+        # Block on the final state so the reported wall-time includes device
+        # work (the reference's time.time() pairs measure eager CPU
+        # execution; under async dispatch the analogue requires a sync point).
+        jax.block_until_ready(state.params)
+        seconds = total_timer.stop()
+        if checkpointer is not None:
+            checkpointer.wait()  # durability barrier, outside the timed span
+        if sink is not None:
+            sink.write({
+                "kind": "run",
+                "train_seconds": seconds,
+                "epochs": len(history),
+                "final_loss": history[-1]["loss"] if history else None,
+            })
     finally:
-        # An exception mid-window must still stop the (process-global) jax
-        # profiler, or every later trace in this process fails to start.
-        tracer.close()
-    # Block on the final state so the reported wall-time includes device work
-    # (the reference's time.time() pairs measure eager CPU execution; under
-    # async dispatch the analogue requires a sync point).
-    jax.block_until_ready(state.params)
-    seconds = total_timer.stop()
-    if checkpointer is not None:
-        checkpointer.wait()  # durability barrier, outside the timed span
+        if sink is not None:
+            sink.close()
     emit(f"Training Time: {seconds:.3f} sec")
     return FitResult(state=state, train_seconds=seconds, history=history)
 
 
 def _run_epochs(
     state, step_fn, train_loader, epochs, rng, mesh, log_every, emit,
-    tracer, checkpointer, checkpoint_every, span_timer,
+    tracer, checkpointer, checkpoint_every, span_timer, sink=None,
 ):
     history: list[dict] = []
     global_step = 0
@@ -187,6 +207,10 @@ def _run_epochs(
         computed = epoch_metrics.compute()
         computed["epoch"] = epoch
         history.append(computed)
+        if sink is not None:
+            # state.step (not the run-local counter): stays consistent with
+            # checkpoint labels across resumed runs.
+            sink.write({"kind": "epoch", "step": int(state.step), **computed})
         if log_every:
             emit(f"epoch {epoch} done | {epoch_metrics.log_line()}")
         if checkpointer is not None and (

@@ -130,6 +130,47 @@ class Mean(Sum):
         return self.total / max(self.count, 1)
 
 
+class MetricsLogger:
+    """Append-only JSONL metrics sink — the structured counterpart of the
+    reference's ``print()``-only observability (SURVEY.md §5 metrics/logging).
+    Each ``write(record)`` appends one JSON line stamped with wall time.
+
+    >>> with MetricsLogger(path) as m:
+    ...     m.write({"kind": "epoch", "epoch": 0, "loss": 1.2})
+    >>> MetricsLogger.read(path)
+    [{"ts": ..., "kind": "epoch", ...}]
+    """
+
+    def __init__(self, path: str):
+        import os
+
+        self.path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._fh = open(self.path, "a", buffering=1)  # line-buffered
+
+    def write(self, record: dict) -> None:
+        import json
+        import time
+
+        self._fh.write(json.dumps({"ts": time.time(), **record}) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        import json
+
+        with open(path) as fh:
+            return [json.loads(line) for line in fh if line.strip()]
+
+
 @dataclass
 class MetricBundle:
     """Named accumulators with one ``log_line`` in the reference's print

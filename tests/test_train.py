@@ -204,6 +204,47 @@ class TestOptimizerKnobs:
         assert result.history[-1]["loss"] < result.history[0]["loss"]
 
 
+class TestMetricsLogger:
+    """Structured JSONL metrics sink — the observability counterpart of the
+    reference's print-only metrics (SURVEY.md §5)."""
+
+    def test_fit_writes_epoch_and_run_records(self, rng, tmp_path):
+        from machine_learning_apache_spark_tpu.train.metrics import (
+            MetricsLogger,
+        )
+
+        feats, labels = _synthetic_classification(rng, n=60)
+        model = MLP(layers=(4, 5, 4, 3))
+        params = model.init(jax.random.key(0), feats[:1])["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.03)
+        )
+        path = str(tmp_path / "metrics.jsonl")
+        fit(
+            state, classification_loss(model.apply),
+            _batches(feats, labels, 30),
+            epochs=3, log_every=0, metrics_file=path,
+        )
+        records = MetricsLogger.read(path)
+        epochs = [r for r in records if r["kind"] == "epoch"]
+        runs = [r for r in records if r["kind"] == "run"]
+        assert len(epochs) == 3 and len(runs) == 1
+        assert all("loss" in r and "ts" in r and "step" in r for r in epochs)
+        assert runs[0]["epochs"] == 3 and runs[0]["train_seconds"] > 0
+
+    def test_recipe_flag_appends_across_runs(self, rng, tmp_path):
+        from machine_learning_apache_spark_tpu.recipes.mlp import train_mlp
+        from machine_learning_apache_spark_tpu.train.metrics import (
+            MetricsLogger,
+        )
+
+        path = str(tmp_path / "m.jsonl")
+        train_mlp(epochs=2, synthetic_n=120, metrics_path=path)
+        train_mlp(epochs=2, synthetic_n=120, metrics_path=path)
+        records = MetricsLogger.read(path)
+        assert len([r for r in records if r["kind"] == "run"]) == 2
+
+
 class TestFitCNN:
     def test_loss_decreases(self, rng):
         # Tiny synthetic FashionMNIST-shaped batch; 20 steps of SGD(0.01).
