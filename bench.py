@@ -311,7 +311,8 @@ def bench_cnn(jax) -> dict:
 
     n_chips = jax.device_count()
     device = jax.devices()[0]
-    model = TinyVGG()
+    on_tpu = device.platform == "tpu"
+    model = TinyVGG(dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     mesh = make_mesh({DATA_AXIS: n_chips})
     batch = CNN_BATCH_PER_CHIP * n_chips
 

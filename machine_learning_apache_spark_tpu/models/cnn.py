@@ -18,15 +18,22 @@ import jax.numpy as jnp
 
 
 class TinyVGG(nn.Module):
-    """Two-block VGG mini. Input ``[B, H, W, C]`` (NHWC), e.g. 28×28×1."""
+    """Two-block VGG mini. Input ``[B, H, W, C]`` (NHWC), e.g. 28×28×1.
+
+    ``dtype`` is the compute dtype (bfloat16 feeds the MXU at full rate on
+    TPU); params stay float32 (flax's param_dtype default) and logits are
+    returned float32 so the loss/softmax never run in half precision.
+    """
 
     hidden_units: int = 10
     num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
         # Accepted for zoo-wide signature uniformity; TinyVGG has no dropout.
         del deterministic
+        x = x.astype(self.dtype)
         for block in range(2):
             for conv in range(2):
                 x = nn.Conv(
@@ -34,12 +41,14 @@ class TinyVGG(nn.Module):
                     kernel_size=(3, 3),
                     strides=1,
                     padding=1,
+                    dtype=self.dtype,
                     name=f"block{block}_conv{conv}",
                 )(x)
                 x = nn.relu(x)
             x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        return nn.Dense(self.num_classes, name="classifier")(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="classifier")(x)
+        return logits.astype(jnp.float32)
 
 
 # The reference's class name, for API-parity imports.

@@ -81,6 +81,16 @@ def resolve_mesh(
     return None
 
 
+def default_compute_dtype(override: str | None = None):
+    """Platform-default compute dtype: bfloat16 on TPU (full-rate MXU),
+    float32 elsewhere; an explicit dtype string wins on any platform."""
+    import jax.numpy as jnp
+
+    if override is not None:
+        return jnp.dtype(override)
+    return jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+
+
 def with_overrides(recipe, overrides: dict):
     """``dataclasses.replace`` with the no-override fast path — the shared
     ``train_x(recipe, **overrides)`` config idiom."""

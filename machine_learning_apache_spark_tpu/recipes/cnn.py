@@ -28,6 +28,7 @@ from machine_learning_apache_spark_tpu.train.loop import (
 from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
 from machine_learning_apache_spark_tpu.recipes._common import (
     checkpointing,
+    default_compute_dtype,
     make_loaders,
     with_overrides,
     resolve_mesh,
@@ -50,6 +51,9 @@ class CNNRecipe:
     synthetic_n: int = 4096
     use_mesh: bool = True
     log_every: int = 0
+    # None → platform default (bfloat16 on TPU's MXU, float32 elsewhere);
+    # an explicit dtype string is honored on any platform.
+    dtype: str | None = None
     # Checkpoint/resume (persistence the reference lacks, SURVEY.md §5):
     # save every checkpoint_every epochs under checkpoint_dir; when the dir
     # already holds checkpoints and resume=True, continue from the latest.
@@ -83,7 +87,11 @@ def train_cnn(recipe: CNNRecipe | None = None, **overrides) -> dict:
         train_ds, test_ds, batch_size=r.batch_size, mesh=mesh, seed=r.seed
     )
 
-    model = TinyVGG(hidden_units=r.hidden_units, num_classes=r.num_classes)
+    model = TinyVGG(
+        hidden_units=r.hidden_units,
+        num_classes=r.num_classes,
+        dtype=default_compute_dtype(r.dtype),
+    )
     params = model.init(jax.random.key(r.seed), train_ds[:1][0])["params"]
     state = TrainState.create(
         apply_fn=model.apply,

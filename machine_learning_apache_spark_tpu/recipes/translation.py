@@ -31,6 +31,7 @@ from machine_learning_apache_spark_tpu.train.losses import masked_token_cross_en
 from machine_learning_apache_spark_tpu.train.state import TrainState, make_optimizer
 from machine_learning_apache_spark_tpu.recipes._common import (
     checkpointing,
+    default_compute_dtype,
     make_loaders,
     with_overrides,
     resolve_mesh,
@@ -161,13 +162,7 @@ def train_translator(
         dropout=r.dropout,
         max_len=r.max_len,
         remat=r.remat,
-        dtype=jnp.dtype(r.dtype)
-        if r.dtype is not None
-        else (
-            jnp.bfloat16
-            if jax.devices()[0].platform == "tpu"
-            else jnp.float32
-        ),
+        dtype=default_compute_dtype(r.dtype),
     )
     model = Transformer(cfg)
 

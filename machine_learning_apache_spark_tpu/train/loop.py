@@ -133,7 +133,13 @@ def fit(
 
     from machine_learning_apache_spark_tpu.train.metrics import MetricsLogger
 
-    sink = MetricsLogger(metrics_file) if metrics_file else None
+    # Rank-0 gated like every other metrics emission (utils.logging): a
+    # multi-process gang writing one shared file would duplicate every record.
+    sink = (
+        MetricsLogger(metrics_file)
+        if metrics_file and jax.process_index() == 0
+        else None
+    )
     total_timer = Timer("train").start()
     span_timer = Timer("span").start()
     try:
@@ -159,7 +165,7 @@ def fit(
                 "kind": "run",
                 "train_seconds": seconds,
                 "epochs": len(history),
-                "final_loss": history[-1]["loss"] if history else None,
+                "final_loss": history[-1].get("loss") if history else None,
             })
     finally:
         if sink is not None:

@@ -53,6 +53,22 @@ class TestCNN:
         params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
         assert params["classifier"]["kernel"].shape == (7 * 7 * 10, 10)
 
+    def test_bfloat16_compute_keeps_f32_params_and_logits(self):
+        """Mixed-precision contract: bf16 conv/dense compute on the MXU, but
+        params stay float32 (optimizer precision) and logits return float32
+        (softmax/loss precision)."""
+        model = TinyVGG(hidden_units=4, dtype=jnp.bfloat16)
+        x = jnp.ones((2, 28, 28, 1), jnp.float32)
+        params = model.init(jax.random.key(0), x)["params"]
+        assert all(
+            p.dtype == jnp.float32 for p in jax.tree.leaves(params)
+        )
+        out = model.apply({"params": params}, x)
+        assert out.dtype == jnp.float32
+        # numerics stay close to the f32 model with the same params
+        ref = TinyVGG(hidden_units=4).apply({"params": params}, x)
+        assert jnp.max(jnp.abs(out - ref)) < 0.15
+
 
 class TestLSTM:
     def test_forward_shape(self):
