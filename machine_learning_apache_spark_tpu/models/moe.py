@@ -1,0 +1,155 @@
+"""Mixture-of-experts feed-forward — expert parallelism for the zoo.
+
+The reference has no MoE anywhere (SURVEY.md §2.3: EP "out of scope" for
+parity); this module is capability headroom completing the mesh's five
+axes (``parallel.mesh``: data/model/seq/pipeline/expert). Design follows
+the canonical TPU MoE shape (Switch Transformer-style top-1 routing with
+static capacity, one-hot einsum dispatch/combine — the Shazeer/Fedus
+lineage all public TPU MoE code uses, e.g. mesh-tensorflow/flaxformer):
+
+- **Static shapes**: every tensor has a compile-time shape. Tokens route to
+  ``capacity = ceil(capacity_factor × tokens / num_experts)`` slots per
+  expert; overflow tokens are *dropped* — their FFN output is zero and the
+  surrounding residual connection carries them through unchanged (the
+  standard Switch behavior, not a bug).
+- **Einsum dispatch**: a boolean dispatch tensor ``D[t, e, c]`` gathers
+  token features into per-expert buffers ``[E, C, d]``; the expert FFNs are
+  one batched matmul pair over the leading expert dim; a weighted combine
+  scatters results back. No gather/scatter ops, no dynamic shapes — XLA
+  tiles everything onto the MXU.
+- **Expert parallelism**: expert weights carry the logical axis ``"expert"``
+  on their leading dim (→ mesh axis ``"expert"`` via
+  ``parallel.tensor_parallel.DEFAULT_RULES``). Under ``pjit`` XLA partitions
+  the dispatch einsum into an all-to-all-shaped exchange and each device
+  runs only its experts — the scaling-book recipe, nothing hand-scheduled.
+- **Load balancing**: the Switch auxiliary loss ``E · Σ_e f_e · p_e``
+  (fraction-routed × mean-router-prob) is sown into the ``"losses"``
+  collection; training code adds ``moe_aux_weight ×`` their mean to the task
+  loss (see ``recipes.translation.make_translation_loss``).
+
+Router numerics are float32 regardless of compute dtype (softmax over a
+handful of logits is precision-critical; bf16 router probs destabilize
+balancing).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEFeedForward(nn.Module):
+    """Drop-in replacement for the dense position-wise FFN.
+
+    Input/output ``[B, S, d_model]``; interface-compatible with
+    ``transformer.FeedForward`` so encoder/decoder layers swap it in behind
+    a config flag.
+    """
+
+    d_model: int
+    ffn_hidden: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        *,
+        valid: jnp.ndarray | None = None,
+        deterministic: bool = True,
+    ):
+        b, s, d = x.shape
+        e = self.num_experts
+        tokens = b * s
+        capacity = max(int(math.ceil(self.capacity_factor * tokens / e)), 1)
+
+        xf = x.reshape(tokens, d)
+        # Pad tokens (valid=False) are excluded from routing entirely: they
+        # never consume a capacity slot (which would drop real tokens at a
+        # far higher rate than capacity_factor implies on padded batches)
+        # and never enter the aux-loss statistics. Their FFN output is zero;
+        # the surrounding residual carries them.
+        if valid is not None and valid.shape != (b, s):
+            raise ValueError(
+                f"valid must be [batch={b}, seq={s}], got {valid.shape}"
+            )
+        vf = (
+            valid.reshape(tokens).astype(jnp.float32)
+            if valid is not None
+            else jnp.ones((tokens,), jnp.float32)
+        )
+
+        # -- router (float32) ------------------------------------------------
+        router_kernel = self.param(
+            "router",
+            nn.with_partitioning(nn.initializers.lecun_normal(), ("embed", None)),
+            (d, e),
+        )
+        logits = (xf.astype(jnp.float32) @ router_kernel.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [T] top-1 (Switch)
+        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+        gate = gate * vf
+
+        # -- capacity assignment --------------------------------------------
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32) * vf[:, None]
+        # Slot within the chosen expert's buffer, in token order (exclusive
+        # running count of prior tokens routed to the same expert).
+        position = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # [T, E]
+        pos_in_expert = position.sum(axis=-1).astype(jnp.int32)  # [T]
+        keep = pos_in_expert < capacity
+        gate = jnp.where(keep, gate, 0.0)
+
+        # Dispatch tensor [T, E, C]: token t → (its expert, its slot).
+        dispatch = (
+            onehot[:, :, None]
+            * jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)[:, None, :]
+            * keep[:, None, None]
+        )
+
+        # -- expert FFNs (batched over the expert dim) ----------------------
+        w_up = self.param(
+            "w_up",
+            nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
+            ),
+            (e, d, self.ffn_hidden),
+        )
+        w_down = self.param(
+            "w_down",
+            nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "mlp", "embed")
+            ),
+            (e, self.ffn_hidden, d),
+        )
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(self.dtype), xf.astype(self.dtype)
+        )
+        h = nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(self.dtype)))
+        h = nn.Dropout(self.dropout, deterministic=deterministic)(h)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
+
+        # -- weighted combine ------------------------------------------------
+        combine = dispatch * gate[:, None, None]  # [T, E, C]
+        out = jnp.einsum(
+            "tec,ecd->td", combine.astype(self.dtype), expert_out
+        ).reshape(b, s, d)
+
+        # -- Switch load-balancing loss -------------------------------------
+        # f_e is the fraction of VALID tokens the router chose per expert
+        # (pre-drop, the Switch paper's definition); p_e the mean router
+        # prob over valid tokens. Drops are a consequence the loss should
+        # shrink, not a term that hides imbalance by zeroing overflow.
+        n_valid = jnp.maximum(vf.sum(), 1.0)
+        frac_routed = onehot.sum(axis=0) / n_valid  # f_e
+        mean_prob = (probs * vf[:, None]).sum(axis=0) / n_valid  # p_e
+        aux = e * jnp.sum(frac_routed * mean_prob)
+        self.sow("losses", "moe_aux", aux)
+
+        return out

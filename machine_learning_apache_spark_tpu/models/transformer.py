@@ -70,6 +70,14 @@ class TransformerConfig:
     # of saved — O(num_layers) → O(1) layer activations live at once, the
     # FLOPs-for-HBM trade that makes long-context training fit.
     remat: bool = False
+    # Mixture-of-experts FFN (models.moe): 0 = dense FFN (the reference's
+    # C19); N > 0 replaces every FFN with N switch-routed experts whose
+    # weights shard over the mesh "expert" axis. The Switch load-balancing
+    # aux losses are sown into the "losses" collection — training code adds
+    # moe_aux_weight × their mean to the task loss.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
 
 
 def _dense(features: int, cfg: TransformerConfig, name: str, logical_out: str):
@@ -267,6 +275,23 @@ class FeedForward(nn.Module):
         )(h)
 
 
+def _make_ffn(cfg: TransformerConfig, name: str):
+    """Dense FFN, or the switch-routed MoE variant when cfg.moe_experts > 0."""
+    if cfg.moe_experts > 0:
+        from machine_learning_apache_spark_tpu.models.moe import MoEFeedForward
+
+        return MoEFeedForward(
+            d_model=cfg.d_model,
+            ffn_hidden=cfg.ffn_hidden,
+            num_experts=cfg.moe_experts,
+            capacity_factor=cfg.moe_capacity_factor,
+            dropout=cfg.dropout,
+            dtype=cfg.dtype,
+            name=name,
+        )
+    return FeedForward(cfg, name=name)
+
+
 class EncoderLayer(nn.Module):
     """Post-LN residual block (C20, ``transformer.py:120-139``)."""
 
@@ -283,7 +308,14 @@ class EncoderLayer(nn.Module):
             x, mask=mask, kv_valid=kv_valid, deterministic=deterministic
         )
         x = nn.LayerNorm(dtype=self.cfg.dtype, name="ln1")(x + drop(attn))
-        ffn = FeedForward(self.cfg, name="ffn")(x, deterministic=deterministic)
+        ffn_kw = (
+            # kv_valid is this layer's own-token validity: pad positions are
+            # excluded from MoE routing (capacity + aux statistics).
+            {"valid": kv_valid} if self.cfg.moe_experts > 0 else {}
+        )
+        ffn = _make_ffn(self.cfg, "ffn")(
+            x, deterministic=deterministic, **ffn_kw
+        )
         return nn.LayerNorm(dtype=self.cfg.dtype, name="ln2")(x + drop(ffn))
 
 
@@ -357,7 +389,16 @@ class DecoderLayer(nn.Module):
             deterministic=deterministic,
         )
         y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln2")(y + drop(cross))
-        ffn = FeedForward(self.cfg, name="ffn")(y, deterministic=deterministic)
+        ffn_kw = (
+            # trg_valid matches y's positions only outside decode: a decode
+            # step feeds [B, 1] tokens while trg_valid spans the cache.
+            {"valid": None if decode else trg_valid}
+            if self.cfg.moe_experts > 0
+            else {}
+        )
+        ffn = _make_ffn(self.cfg, "ffn")(
+            y, deterministic=deterministic, **ffn_kw
+        )
         return nn.LayerNorm(dtype=self.cfg.dtype, name="ln3")(y + drop(ffn))
 
 

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from machine_learning_apache_spark_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
     SEQ_AXIS,
 )
@@ -44,6 +45,10 @@ DEFAULT_RULES: dict[str, str | None] = {
     "vocab": MODEL_AXIS,
     "batch": DATA_AXIS,
     "seq": SEQ_AXIS,
+    # MoE expert weights [E, ...] shard their leading expert dim over the
+    # mesh "expert" axis; XLA partitions the dispatch/combine einsums so each
+    # device computes only its experts' capacity slots (expert parallelism).
+    "expert": EXPERT_AXIS,
 }
 
 

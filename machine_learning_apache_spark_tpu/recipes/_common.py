@@ -34,15 +34,23 @@ def resolve_mesh(
     *,
     model_parallel: int = 1,
     sequence_parallel: int = 1,
+    expert_parallel: int = 1,
 ):
     """Device mesh for a recipe, or None when a mesh buys nothing.
 
     Default is pure data parallelism over every addressable device (the
     reference's DDP world). ``model_parallel=N`` carves an inner ``"model"``
     axis (tensor parallelism over the zoo's logical annotations);
-    ``sequence_parallel=N`` carves a ``"seq"`` axis for ring attention. The
-    remaining devices form the ``"data"`` axis.
+    ``sequence_parallel=N`` carves a ``"seq"`` axis for ring attention;
+    ``expert_parallel=N`` carves an ``"expert"`` axis for MoE expert weights.
+    The remaining devices form the ``"data"`` axis.
     """
+    extra = {
+        "model_parallel": model_parallel,
+        "sequence_parallel": sequence_parallel,
+        "expert_parallel": expert_parallel,
+    }
+    any_extra = any(v > 1 for v in extra.values())
     if jax.process_count() > 1 and not use_mesh:
         # Without a mesh there is no gradient sync: each rank would train an
         # independent replica on its shard and rank 0's metrics would
@@ -52,25 +60,29 @@ def resolve_mesh(
             "independent unsynchronized replicas; run single-process or "
             "keep use_mesh=True"
         )
-    if not use_mesh and (model_parallel > 1 or sequence_parallel > 1):
-        raise ValueError("model/sequence parallelism requires use_mesh=True")
-    have_devices = jax.device_count() > 1 or jax.process_count() > 1
-    if not have_devices and (model_parallel > 1 or sequence_parallel > 1):
-        # Never silently drop a requested parallelism mode: the user would
-        # believe TP/SP was exercised when it wasn't.
+    if not use_mesh and any_extra:
         raise ValueError(
-            f"model_parallel={model_parallel}/sequence_parallel="
-            f"{sequence_parallel} requested but only "
+            "model/sequence/expert parallelism requires use_mesh=True"
+        )
+    have_devices = jax.device_count() > 1 or jax.process_count() > 1
+    if not have_devices and any_extra:
+        # Never silently drop a requested parallelism mode: the user would
+        # believe TP/SP/EP was exercised when it wasn't.
+        raise ValueError(
+            f"{extra} requested but only "
             f"{jax.device_count()} device(s) are available"
         )
     if use_mesh and have_devices:
         from machine_learning_apache_spark_tpu.parallel.mesh import (
+            EXPERT_AXIS,
             MODEL_AXIS,
             SEQ_AXIS,
             make_mesh,
         )
 
         axes = {DATA_AXIS: -1}
+        if expert_parallel > 1:
+            axes[EXPERT_AXIS] = expert_parallel
         if model_parallel > 1:
             axes[MODEL_AXIS] = model_parallel
         if sequence_parallel > 1:

@@ -67,6 +67,11 @@ class TranslationRecipe:
     # through to the dense/flash path).
     model_parallel: int = 1
     sequence_parallel: int = 1
+    # Mixture-of-experts FFN (models.moe): moe_experts switch-routed experts
+    # per FFN site; expert_parallel shards their weights over a mesh
+    # "expert" axis. The Switch aux loss joins the task loss automatically.
+    moe_experts: int = 0
+    expert_parallel: int = 1
     # jax.checkpoint over encoder/decoder layers: recompute activations in
     # the backward instead of saving them — the FLOPs-for-HBM trade for
     # long-context / deep-stack training.
@@ -95,17 +100,30 @@ class TranslationRecipe:
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
     """Teacher-forced pad-masked CE over ``(src, trg)`` batches — the manual
-    mask-mean at ``pytorch_machine_translator.py:182-188``."""
+    mask-mean at ``pytorch_machine_translator.py:182-188``.
+
+    MoE models additionally sow Switch load-balancing losses into the
+    ``"losses"`` collection; their mean joins the task loss at
+    ``cfg.moe_aux_weight`` (reported as ``moe_aux`` in the step metrics).
+    """
+    moe = getattr(model.cfg, "moe_experts", 0) > 0
 
     def loss_fn(params, batch, rng):
         src, trg = batch
-        logits = model.apply(
-            {"params": params},
-            src,
-            trg[:, :-1],
+        kwargs = dict(
             deterministic=not train,
             rngs={"dropout": rng} if train else None,
         )
+        if moe:
+            logits, mutated = model.apply(
+                {"params": params}, src, trg[:, :-1],
+                mutable=["losses"], **kwargs,
+            )
+            aux_terms = jax.tree.leaves(mutated.get("losses", {}))
+            aux = sum(aux_terms) / max(len(aux_terms), 1)
+            loss = masked_token_cross_entropy(logits, trg[:, 1:], pad_id)
+            return loss + model.cfg.moe_aux_weight * aux, {"moe_aux": aux}
+        logits = model.apply({"params": params}, src, trg[:, :-1], **kwargs)
         loss = masked_token_cross_entropy(logits, trg[:, 1:], pad_id)
         return loss, {}
 
@@ -162,14 +180,28 @@ def train_translator(
         dropout=r.dropout,
         max_len=r.max_len,
         remat=r.remat,
+        moe_experts=r.moe_experts,
         dtype=default_compute_dtype(r.dtype),
     )
     model = Transformer(cfg)
 
+    if r.moe_experts and r.moe_experts % max(r.expert_parallel, 1):
+        raise ValueError(
+            f"moe_experts={r.moe_experts} must divide evenly over "
+            f"expert_parallel={r.expert_parallel}"
+        )
+    if r.expert_parallel > 1 and not r.moe_experts:
+        # Never silently carve a dead mesh axis: without MoE weights no
+        # param carries the "expert" logical axis, so the devices would
+        # replicate identical work while the user believes EP ran.
+        raise ValueError(
+            f"expert_parallel={r.expert_parallel} requires moe_experts > 0"
+        )
     mesh = resolve_mesh(
         r.use_mesh,
         model_parallel=r.model_parallel,
         sequence_parallel=r.sequence_parallel,
+        expert_parallel=r.expert_parallel,
     )
     train_loader, val_loader = make_loaders(
         train_ds, val_ds, batch_size=r.batch_size, mesh=mesh, seed=r.seed
