@@ -7,16 +7,18 @@ the canonical TPU MoE shape (Switch Transformer-style top-1 routing with
 static capacity, one-hot einsum dispatch/combine — the Shazeer/Fedus
 lineage all public TPU MoE code uses, e.g. mesh-tensorflow/flaxformer):
 
-- **Static shapes**: every tensor has a compile-time shape. Tokens route to
-  ``capacity = ceil(capacity_factor × tokens / num_experts)`` slots per
-  expert; overflow tokens are *dropped* — their FFN output is zero and the
+- **Static shapes**: every tensor has a compile-time shape. Each sequence
+  is its own routing group with ``capacity = ceil(capacity_factor × seq /
+  num_experts)`` slots per expert (the mesh-tf/flaxformer grouping — it
+  bounds the dispatch tensor at ``cf·b·s²`` rather than ``cf·(b·s)²``);
+  overflow tokens are *dropped* — their FFN output is zero and the
   surrounding residual connection carries them through unchanged (the
   standard Switch behavior, not a bug).
-- **Einsum dispatch**: a boolean dispatch tensor ``D[t, e, c]`` gathers
-  token features into per-expert buffers ``[E, C, d]``; the expert FFNs are
-  one batched matmul pair over the leading expert dim; a weighted combine
-  scatters results back. No gather/scatter ops, no dynamic shapes — XLA
-  tiles everything onto the MXU.
+- **Einsum dispatch**: a boolean dispatch tensor ``D[b, s, e, c]`` gathers
+  token features into per-expert buffers ``[E, B, C, d]``; the expert FFNs
+  are one batched matmul pair over the leading expert dim; a weighted
+  combine scatters results back. No gather/scatter ops, no dynamic shapes —
+  XLA tiles everything onto the MXU.
 - **Expert parallelism**: expert weights carry the logical axis ``"expert"``
   on their leading dim (→ mesh axis ``"expert"`` via
   ``parallel.tensor_parallel.DEFAULT_RULES``). Under ``pjit`` XLA partitions
@@ -66,10 +68,13 @@ class MoEFeedForward(nn.Module):
     ):
         b, s, d = x.shape
         e = self.num_experts
-        tokens = b * s
-        capacity = max(int(math.ceil(self.capacity_factor * tokens / e)), 1)
+        # Per-SEQUENCE routing groups (the mesh-tf/flaxformer convention):
+        # each batch row assigns its own capacity = ceil(cf · s / E) slots
+        # per expert, so the dispatch tensor is [b, s, E, C] ~ cf·b·s² —
+        # bounded by the sequence length, not (batch·seq)², which at
+        # long-context scale is the difference between MBs and GBs.
+        capacity = max(int(math.ceil(self.capacity_factor * s / e)), 1)
 
-        xf = x.reshape(tokens, d)
         # Pad tokens (valid=False) are excluded from routing entirely: they
         # never consume a capacity slot (which would drop real tokens at a
         # far higher rate than capacity_factor implies on padded batches)
@@ -80,9 +85,9 @@ class MoEFeedForward(nn.Module):
                 f"valid must be [batch={b}, seq={s}], got {valid.shape}"
             )
         vf = (
-            valid.reshape(tokens).astype(jnp.float32)
+            valid.astype(jnp.float32)
             if valid is not None
-            else jnp.ones((tokens,), jnp.float32)
+            else jnp.ones((b, s), jnp.float32)
         )
 
         # -- router (float32) ------------------------------------------------
@@ -91,26 +96,32 @@ class MoEFeedForward(nn.Module):
             nn.with_partitioning(nn.initializers.lecun_normal(), ("embed", None)),
             (d, e),
         )
-        logits = (xf.astype(jnp.float32) @ router_kernel.astype(jnp.float32))
-        probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
-        expert_idx = jnp.argmax(probs, axis=-1)  # [T] top-1 (Switch)
-        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+        logits = jnp.einsum(
+            "bsd,de->bse",
+            x.astype(jnp.float32),
+            router_kernel.astype(jnp.float32),
+        )
+        probs = jax.nn.softmax(logits, axis=-1)  # [B, S, E]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [B, S] top-1 (Switch)
+        gate = jnp.take_along_axis(probs, expert_idx[..., None], axis=-1)[..., 0]
         gate = gate * vf
 
-        # -- capacity assignment --------------------------------------------
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32) * vf[:, None]
-        # Slot within the chosen expert's buffer, in token order (exclusive
-        # running count of prior tokens routed to the same expert).
-        position = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # [T, E]
-        pos_in_expert = position.sum(axis=-1).astype(jnp.int32)  # [T]
+        # -- capacity assignment (within each row's groups) ------------------
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32) * vf[..., None]
+        # Slot within the chosen expert's buffer, in token order within the
+        # row (exclusive running count of prior same-expert tokens).
+        position = (jnp.cumsum(onehot, axis=1) - onehot) * onehot  # [B, S, E]
+        pos_in_expert = position.sum(axis=-1).astype(jnp.int32)  # [B, S]
         keep = pos_in_expert < capacity
         gate = jnp.where(keep, gate, 0.0)
 
-        # Dispatch tensor [T, E, C]: token t → (its expert, its slot).
+        # Dispatch tensor [B, S, E, C]: token (b, s) → (its expert, its slot).
         dispatch = (
-            onehot[:, :, None]
-            * jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)[:, None, :]
-            * keep[:, None, None]
+            onehot[..., None]
+            * jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)[
+                :, :, None, :
+            ]
+            * keep[..., None, None]
         )
 
         # -- expert FFNs (batched over the expert dim) ----------------------
@@ -129,17 +140,19 @@ class MoEFeedForward(nn.Module):
             (e, self.ffn_hidden, d),
         )
         expert_in = jnp.einsum(
-            "tec,td->ecd", dispatch.astype(self.dtype), xf.astype(self.dtype)
+            "bsec,bsd->ebcd", dispatch.astype(self.dtype), x.astype(self.dtype)
         )
-        h = nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(self.dtype)))
+        h = nn.relu(
+            jnp.einsum("ebcd,edf->ebcf", expert_in, w_up.astype(self.dtype))
+        )
         h = nn.Dropout(self.dropout, deterministic=deterministic)(h)
-        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
+        expert_out = jnp.einsum("ebcf,efd->ebcd", h, w_down.astype(self.dtype))
 
         # -- weighted combine ------------------------------------------------
-        combine = dispatch * gate[:, None, None]  # [T, E, C]
+        combine = dispatch * gate[..., None, None]  # [B, S, E, C]
         out = jnp.einsum(
-            "tec,ecd->td", combine.astype(self.dtype), expert_out
-        ).reshape(b, s, d)
+            "bsec,ebcd->bsd", combine.astype(self.dtype), expert_out
+        )
 
         # -- Switch load-balancing loss -------------------------------------
         # f_e is the fraction of VALID tokens the router chose per expert
@@ -147,8 +160,8 @@ class MoEFeedForward(nn.Module):
         # prob over valid tokens. Drops are a consequence the loss should
         # shrink, not a term that hides imbalance by zeroing overflow.
         n_valid = jnp.maximum(vf.sum(), 1.0)
-        frac_routed = onehot.sum(axis=0) / n_valid  # f_e
-        mean_prob = (probs * vf[:, None]).sum(axis=0) / n_valid  # p_e
+        frac_routed = onehot.sum(axis=(0, 1)) / n_valid  # f_e
+        mean_prob = (probs * vf[..., None]).sum(axis=(0, 1)) / n_valid  # p_e
         aux = e * jnp.sum(frac_routed * mean_prob)
         self.sow("losses", "moe_aux", aux)
 

@@ -299,7 +299,8 @@ class EncoderLayer(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x, mask=None, kv_valid=None, deterministic: bool = True
+        self, x, mask=None, kv_valid=None, deterministic: bool = True,
+        token_valid=None,
     ):
         # ``deterministic`` is positional-friendly: nn.remat marks it static
         # by argnum (keyword-only args cannot be static under jax.checkpoint).
@@ -309,9 +310,12 @@ class EncoderLayer(nn.Module):
         )
         x = nn.LayerNorm(dtype=self.cfg.dtype, name="ln1")(x + drop(attn))
         ffn_kw = (
-            # kv_valid is this layer's own-token validity: pad positions are
-            # excluded from MoE routing (capacity + aux statistics).
-            {"valid": kv_valid} if self.cfg.moe_experts > 0 else {}
+            # token_valid (always derived from the tokens, independent of
+            # any attention-mask override) excludes pad positions from MoE
+            # routing — capacity slots and aux statistics alike.
+            {"valid": token_valid if token_valid is not None else kv_valid}
+            if self.cfg.moe_experts > 0
+            else {}
         )
         ffn = _make_ffn(self.cfg, "ffn")(
             x, deterministic=deterministic, **ffn_kw
@@ -336,6 +340,11 @@ class Encoder(nn.Module):
         x = SentenceEmbedding(self.cfg.src_vocab_size, self.cfg, name="embed")(
             src_tokens, deterministic=deterministic
         )
+        # MoE pad exclusion must not depend on the attention-mask override:
+        # derive token validity from the tokens themselves.
+        token_valid = (
+            src_tokens != self.cfg.pad_id if self.cfg.moe_experts > 0 else None
+        )
         # static_argnums counts self at 0; deterministic is arg 4.
         layer_cls = (
             nn.remat(EncoderLayer, static_argnums=(4,))
@@ -344,7 +353,7 @@ class Encoder(nn.Module):
         )
         for i in range(self.cfg.num_layers):
             x = layer_cls(self.cfg, name=f"layer_{i}")(
-                x, src_mask, src_valid, deterministic
+                x, src_mask, src_valid, deterministic, token_valid
             )
         return x
 
@@ -367,6 +376,7 @@ class DecoderLayer(nn.Module):
         self_causal: bool = False,
         decode: bool = False,
         deterministic: bool = True,
+        token_valid=None,
     ):
         # Flags are plain positional-friendly bools so nn.remat can mark
         # them static by argnum (7, 8, 9; self counts at 0).
@@ -390,9 +400,13 @@ class DecoderLayer(nn.Module):
         )
         y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln2")(y + drop(cross))
         ffn_kw = (
-            # trg_valid matches y's positions only outside decode: a decode
-            # step feeds [B, 1] tokens while trg_valid spans the cache.
-            {"valid": None if decode else trg_valid}
+            # token_valid is derived from the tokens regardless of mask
+            # overrides; it matches y's positions only outside decode (a
+            # decode step feeds [B, 1] tokens while validity spans the
+            # cache), so the decode path routes its single real token.
+            {"valid": None if decode else (
+                token_valid if token_valid is not None else trg_valid
+            )}
             if self.cfg.moe_experts > 0
             else {}
         )
@@ -425,6 +439,10 @@ class Decoder(nn.Module):
             deterministic=deterministic,
             position_offset=position_offset,
         )
+        # MoE pad exclusion, independent of any attention-mask override.
+        token_valid = (
+            trg_tokens != self.cfg.pad_id if self.cfg.moe_experts > 0 else None
+        )
         # Remat only on the training path: the decode cache is a mutable
         # variable collection, which jax.checkpoint cannot rewind.
         layer_cls = (
@@ -443,6 +461,7 @@ class Decoder(nn.Module):
                 self_causal,
                 decode,
                 deterministic,
+                token_valid,
             )
         return y
 

@@ -72,6 +72,8 @@ class TranslationRecipe:
     # "expert" axis. The Switch aux loss joins the task loss automatically.
     moe_experts: int = 0
     expert_parallel: int = 1
+    moe_capacity_factor: float = 1.25  # per-expert slots = ceil(cf·s/E)
+    moe_aux_weight: float = 1e-2  # load-balance loss weight
     # jax.checkpoint over encoder/decoder layers: recompute activations in
     # the backward instead of saving them — the FLOPs-for-HBM trade for
     # long-context / deep-stack training.
@@ -181,6 +183,8 @@ def train_translator(
         max_len=r.max_len,
         remat=r.remat,
         moe_experts=r.moe_experts,
+        moe_capacity_factor=r.moe_capacity_factor,
+        moe_aux_weight=r.moe_aux_weight,
         dtype=default_compute_dtype(r.dtype),
     )
     model = Transformer(cfg)

@@ -133,6 +133,40 @@ class TestMoETransformer:
         # one aux per FFN site: 2 encoder layers + 2 decoder layers
         assert len(jax.tree.leaves(mutated["losses"])) == 4
 
+    def test_pad_exclusion_survives_mask_override(self):
+        """Explicit attention masks must not disable MoE pad exclusion:
+        logits with semantically-identical explicit masks match the
+        structured-mask defaults (if pads re-entered routing they would
+        evict real tokens and change real-token outputs)."""
+        from machine_learning_apache_spark_tpu.ops.masks import (
+            combine_masks,
+            make_causal_mask,
+            make_padding_mask,
+        )
+
+        cfg = self._cfg()
+        model = Transformer(cfg)
+        rng = np.random.default_rng(0)
+        src = jnp.asarray(rng.integers(4, 60, (2, 10)), jnp.int32)
+        trg = jnp.asarray(rng.integers(4, 60, (2, 8)), jnp.int32)
+        # heavy padding tails
+        src = src.at[:, 6:].set(0)
+        trg = trg.at[:, 5:].set(0)
+        params = model.init(jax.random.key(0), src, trg)["params"]
+
+        default = model.apply({"params": params}, src, trg)
+        src_mask = make_padding_mask(src, cfg.pad_id)
+        trg_mask = combine_masks(
+            make_padding_mask(trg, cfg.pad_id), make_causal_mask(8)
+        )
+        cross = make_padding_mask(src, cfg.pad_id)
+        explicit = model.apply(
+            {"params": params}, src, trg, src_mask, trg_mask, cross
+        )
+        np.testing.assert_allclose(
+            np.asarray(default), np.asarray(explicit), atol=1e-5
+        )
+
     def test_expert_sharding_on_mesh(self):
         from machine_learning_apache_spark_tpu.parallel.mesh import (
             DATA_AXIS,
