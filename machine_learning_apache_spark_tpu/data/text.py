@@ -281,6 +281,20 @@ class TextPipeline:
             )
         self.tokenizer = get_tokenizer(tokenizer)
         self.vocab = vocab
+        # Reconstruction spec (inference.Translator.save/load): everything
+        # needed to rebuild this pipeline around a saved vocab. A callable
+        # tokenizer is recorded by name and must be re-registered on load.
+        self.spec = {
+            "tokenizer": (
+                tokenizer
+                if isinstance(tokenizer, str)
+                else getattr(tokenizer, "__name__", "custom")
+            ),
+            "max_seq_len": max_seq_len,
+            "fixed_len": fixed_len,
+            "add_sos": add_sos,
+            "add_eos": add_eos,
+        }
         steps: list = [VocabTransform(vocab)]
         if add_sos:
             steps.append(AddToken(SOS_ID, begin=True))
@@ -323,7 +337,10 @@ class TextPipeline:
         vocab = Vocab.build_from_iterator(
             (tok(t) for t in texts), min_freq=min_freq, max_tokens=max_tokens
         )
-        return cls(vocab, tokenizer=tok, **kwargs)
+        # Pass the ORIGINAL argument through (init re-resolves): a string
+        # name must reach the reconstruction spec as the registry key, not
+        # as the resolved function's __name__.
+        return cls(vocab, tokenizer=tokenizer, **kwargs)
 
 
 def classification_pipeline(

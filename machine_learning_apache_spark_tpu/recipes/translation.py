@@ -136,6 +136,7 @@ def train_translator(
     recipe: TranslationRecipe | None = None,
     *,
     _return_state: bool = False,
+    _return_translator: bool = False,
     **overrides,
 ) -> dict:
     r = with_overrides(recipe or TranslationRecipe(), overrides)
@@ -344,4 +345,12 @@ def train_translator(
         # Test/inspection hook — the state is NOT picklable across the
         # launcher boundary, so it never rides the default result dict.
         out["state"] = result.state
+    if _return_translator:
+        # Text-in/text-out handle on the trained model (inference.Translator)
+        # — like the state, it never crosses the launcher boundary.
+        from machine_learning_apache_spark_tpu.inference import Translator
+
+        out["translator"] = Translator(
+            model, result.state.params, src_pipe, trg_pipe
+        )
     return out

@@ -1,0 +1,85 @@
+"""inference.Translator: raw-string translation over a trained model, with
+save/load round-trip — the deployment story the reference lacks (it trains
+and discards, quirk Q7 / SURVEY.md §5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu.inference import Translator
+from machine_learning_apache_spark_tpu.recipes.translation import train_translator
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """A translator trained well on the deterministic word→word synthetic
+    task (each source word maps to exactly one target word)."""
+    out = train_translator(
+        epochs=6, synthetic_n=1024, batch_size=16, max_len=10,
+        d_model=64, ffn_hidden=128, num_heads=4, dropout=0.0, log_every=0,
+        use_mesh=False, seed=7,
+        _return_translator=True,
+    )
+    return out["translator"], out
+
+
+class TestTranslator:
+    def test_translates_strings(self, trained):
+        t, _ = trained
+        from machine_learning_apache_spark_tpu.data.datasets import (
+            synthetic_translation_pairs,
+        )
+
+        pairs = synthetic_translation_pairs(1024, min_len=3, max_len=6, seed=7)
+        srcs = [s for s, _ in pairs[:8]]
+        refs = [r for _, r in pairs[:8]]
+        hyps = t(srcs)
+        assert len(hyps) == 8 and all(isinstance(h, str) for h in hyps)
+        # deterministic word-for-word task: a well-trained model emits the
+        # exact target words for most positions
+        correct = total = 0
+        for hyp, ref in zip(hyps, refs):
+            h, r = hyp.split(), ref.split()
+            total += len(r)
+            correct += sum(a == b for a, b in zip(h, r))
+        assert correct / total > 0.6, (correct, total, hyps[:2], refs[:2])
+
+    def test_methods_agree_on_shapes(self, trained):
+        t, _ = trained
+        srcs = ["one two three"]
+        for method, kw in [
+            ("greedy", {}),
+            ("beam", {"beam_size": 3}),
+            ("sample", {"temperature": 0.5, "top_k": 5}),
+        ]:
+            out = t(srcs, method=method, **kw)
+            assert len(out) == 1 and isinstance(out[0], str)
+        with pytest.raises(ValueError, match="method"):
+            t(srcs, method="nope")
+
+    def test_unregistered_tokenizer_fails_at_save(self, trained, tmp_path):
+        """A pipeline built around a bare callable cannot be rebuilt by
+        load(); save() must refuse up front, not persist an unloadable
+        model."""
+        from machine_learning_apache_spark_tpu.data.text import TextPipeline
+
+        t, _ = trained
+        broken = Translator(
+            t.model, t.params,
+            TextPipeline(t.src_pipe.vocab, lambda s: s.split(), max_seq_len=9),
+            t.trg_pipe,
+        )
+        with pytest.raises(ValueError, match="not a registered name"):
+            broken.save(str(tmp_path / "broken"))
+
+    def test_save_load_round_trip(self, trained, tmp_path):
+        t, _ = trained
+        srcs = ["alpha beta gamma", "delta epsilon"]
+        before = t(srcs)
+        t.save(str(tmp_path / "model"))
+        t2 = Translator.load(str(tmp_path / "model"))
+        after = t2(srcs)
+        assert before == after
+        # vocab round-trips exactly, specials included
+        assert t2.trg_pipe.vocab.itos == t.trg_pipe.vocab.itos
+        assert t2.src_pipe.vocab["<unk>"] == t.src_pipe.vocab["<unk>"]
