@@ -86,9 +86,15 @@ class Translator:
                 beam_size=beam_size, length_penalty=length_penalty, **kw,
             )
         elif method == "sample":
+            if rng is None:
+                # A silent fixed default would return identical "samples"
+                # on every call — the opposite of what sampling is for.
+                raise ValueError(
+                    "method='sample' requires an explicit rng "
+                    "(e.g. rng=jax.random.key(seed))"
+                )
             ys = sample_translate(
-                self.model, self.params, src,
-                rng if rng is not None else jax.random.key(0),
+                self.model, self.params, src, rng,
                 temperature=temperature, top_k=top_k, top_p=top_p, **kw,
             )
         else:
@@ -116,16 +122,25 @@ class Translator:
         for pipe in (self.src_pipe, self.trg_pipe):
             # Fail at save time, not at load time with the model already
             # persisted unrecoverably: the recorded tokenizer name must
-            # resolve from the registry on a fresh process.
+            # resolve from the registry on a fresh process — and to the
+            # SAME callable this pipeline used (a custom function whose
+            # __name__ shadows a registry key would be silently swapped
+            # for the built-in on load, tokenizing differently).
+            name = pipe.spec["tokenizer"]
             try:
-                get_tokenizer(pipe.spec["tokenizer"])
+                resolved = get_tokenizer(name)
             except Exception as e:
                 raise ValueError(
-                    f"tokenizer {pipe.spec['tokenizer']!r} is not a "
-                    "registered name; Translator.save requires pipelines "
-                    "built with a registry tokenizer so load() can rebuild "
-                    "them"
+                    f"tokenizer {name!r} is not a registered name; "
+                    "Translator.save requires pipelines built with a "
+                    "registry tokenizer so load() can rebuild them"
                 ) from e
+            if resolved is not pipe.tokenizer:
+                raise ValueError(
+                    f"tokenizer {name!r} resolves to a different callable "
+                    "than this pipeline uses; register the custom "
+                    "tokenizer under its own name before saving"
+                )
         cfg = dataclasses.asdict(self.model.cfg)
         cfg["dtype"] = jnp.dtype(cfg["dtype"]).name
         meta = {
@@ -135,9 +150,17 @@ class Translator:
             "src_pipe": self.src_pipe.spec,
             "trg_pipe": self.trg_pipe.spec,
         }
+        # Params first (orbax refuses to overwrite: clear a stale tree), the
+        # metadata last — a failed save can leave an old params tree behind,
+        # but never a NEW translator.json pointing at OLD params.
+        params_path = os.path.join(directory, "params")
+        if os.path.exists(params_path):
+            import shutil
+
+            shutil.rmtree(params_path)
+        save_params(params_path, self.params)
         with open(os.path.join(directory, "translator.json"), "w") as fh:
             json.dump(meta, fh)
-        save_params(os.path.join(directory, "params"), self.params)
 
     @classmethod
     def load(cls, directory: str) -> "Translator":

@@ -50,12 +50,14 @@ class TestTranslator:
         for method, kw in [
             ("greedy", {}),
             ("beam", {"beam_size": 3}),
-            ("sample", {"temperature": 0.5, "top_k": 5}),
+            ("sample", {"temperature": 0.5, "top_k": 5, "rng": jax.random.key(0)}),
         ]:
             out = t(srcs, method=method, **kw)
             assert len(out) == 1 and isinstance(out[0], str)
         with pytest.raises(ValueError, match="method"):
             t(srcs, method="nope")
+        with pytest.raises(ValueError, match="rng"):
+            t(srcs, method="sample")  # silent fixed default would repeat
 
     def test_unregistered_tokenizer_fails_at_save(self, trained, tmp_path):
         """A pipeline built around a bare callable cannot be rebuilt by
@@ -83,3 +85,24 @@ class TestTranslator:
         # vocab round-trips exactly, specials included
         assert t2.trg_pipe.vocab.itos == t.trg_pipe.vocab.itos
         assert t2.src_pipe.vocab["<unk>"] == t.src_pipe.vocab["<unk>"]
+        # re-save over the same directory is a clean overwrite
+        t2.save(str(tmp_path / "model"))
+        assert Translator.load(str(tmp_path / "model"))(srcs) == before
+
+    def test_shadowing_custom_tokenizer_refused(self, trained, tmp_path):
+        """A custom callable whose __name__ collides with a registry key
+        must not be silently swapped for the built-in on load."""
+        from machine_learning_apache_spark_tpu.data.text import TextPipeline
+
+        t, _ = trained
+
+        def word_punct(s):  # shadows the registry name
+            return s.split()
+
+        broken = Translator(
+            t.model, t.params,
+            TextPipeline(t.src_pipe.vocab, word_punct, max_seq_len=9),
+            t.trg_pipe,
+        )
+        with pytest.raises(ValueError, match="different callable"):
+            broken.save(str(tmp_path / "shadow"))
