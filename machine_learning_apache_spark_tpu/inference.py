@@ -40,6 +40,190 @@ from machine_learning_apache_spark_tpu.models import (
 from machine_learning_apache_spark_tpu.train.metrics import strip_special_ids
 
 
+def _check_registered_tokenizer(pipe: TextPipeline) -> None:
+    """The recorded tokenizer name must resolve from the registry on a
+    fresh process — and to the SAME callable this pipeline used (a custom
+    function whose ``__name__`` shadows a registry key would be silently
+    swapped for the built-in on load, tokenizing differently)."""
+    from machine_learning_apache_spark_tpu.data.text import get_tokenizer
+
+    name = pipe.spec["tokenizer"]
+    try:
+        resolved = get_tokenizer(name)
+    except Exception as e:
+        raise ValueError(
+            f"tokenizer {name!r} is not a registered name; save requires "
+            "pipelines built with a registry tokenizer so load() can "
+            "rebuild them"
+        ) from e
+    if resolved is not pipe.tokenizer:
+        raise ValueError(
+            f"tokenizer {name!r} resolves to a different callable than "
+            "this pipeline uses; register the custom tokenizer under its "
+            "own name before saving"
+        )
+
+
+def _overwrite_params(path: str, params) -> None:
+    """orbax refuses to overwrite: clear a stale tree, then save."""
+    from machine_learning_apache_spark_tpu.train.checkpoint import save_params
+
+    if os.path.exists(path):
+        import shutil
+
+        shutil.rmtree(path)
+    save_params(path, params)
+
+
+def _activation_registry():
+    import flax.linen as nn
+
+    return {"sigmoid": nn.sigmoid, "relu": nn.relu, "tanh": nn.tanh}
+
+
+def _model_spec(model) -> dict:
+    """Serializable (class name, init kwargs) for a zoo classifier model."""
+    import dataclasses as dc
+
+    acts = {fn: name for name, fn in _activation_registry().items()}
+    kwargs = {}
+    for f in dc.fields(model):
+        if f.name in ("parent", "name"):
+            continue
+        v = getattr(model, f.name)
+        if f.name == "dtype":
+            kwargs[f.name] = {"__dtype__": jnp.dtype(v).name}
+        elif callable(v) and not isinstance(v, type):
+            if v not in acts:
+                raise ValueError(
+                    f"field {f.name!r} holds an unserializable callable "
+                    f"{v!r}; use one of {sorted(acts.values())}"
+                )
+            kwargs[f.name] = {"__activation__": acts[v]}
+        elif isinstance(v, (list, tuple)):
+            kwargs[f.name] = list(v)
+        else:
+            kwargs[f.name] = v
+    return {"model_class": type(model).__name__, "model_kwargs": kwargs}
+
+
+def _model_from_spec(spec: dict):
+    from machine_learning_apache_spark_tpu import models as zoo
+
+    cls = getattr(zoo, spec["model_class"])
+    kwargs = {}
+    for k, v in spec["model_kwargs"].items():
+        if isinstance(v, dict) and "__activation__" in v:
+            kwargs[k] = _activation_registry()[v["__activation__"]]
+        elif isinstance(v, dict) and "__dtype__" in v:
+            kwargs[k] = jnp.dtype(v["__dtype__"])
+        elif isinstance(v, list):
+            kwargs[k] = tuple(v)
+        else:
+            kwargs[k] = v
+    return cls(**kwargs)
+
+
+class Classifier:
+    """Trained zoo classifier (MLP / TinyVGG / LSTMClassifier) + optional
+    text pipeline, callable on raw inputs — the ``model.eval()`` +
+    softmax→argmax block every reference script re-implements
+    (``pytorch_cnn.py:154-176``), as a reusable predict surface.
+
+    ``inputs``: feature arrays for MLP/CNN, raw strings (via ``pipeline``)
+    or token-id arrays for the LSTM. ``last_timestep=True`` scores
+    ``logits[:, -1, :]`` (the LSTM recipe's head, ``pytorch_lstm.py:160``).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        pipeline: TextPipeline | None = None,
+        last_timestep: bool = False,
+        batch_size: int = 256,
+    ):
+        import flax.linen as nn
+
+        self.model = model
+        self.params = nn.unbox(params)
+        self.pipeline = pipeline
+        self.last_timestep = last_timestep
+        self.batch_size = batch_size
+
+    def _logits(self, inputs) -> jnp.ndarray:
+        # len()-based guards: bare truthiness on a multi-element array raises.
+        if len(inputs) == 0:
+            raise ValueError("predict called with an empty input batch")
+        if self.pipeline is not None and isinstance(inputs[0], str):
+            inputs = self.pipeline(list(inputs))
+        x = jnp.asarray(inputs)
+        outs = []
+        for i in range(0, len(x), self.batch_size):
+            logits = self.model.apply(
+                {"params": self.params}, x[i : i + self.batch_size]
+            )
+            if self.last_timestep:
+                logits = logits[:, -1, :]
+            outs.append(logits.astype(jnp.float32))
+        return jnp.concatenate(outs, axis=0)
+
+    def predict_proba(self, inputs):
+        return jax.nn.softmax(self._logits(inputs), axis=-1)
+
+    def predict(self, inputs):
+        """argmax class ids — the reference's softmax→argmax eval pattern
+        (softmax is monotonic, so argmax of logits suffices)."""
+        return jnp.argmax(self._logits(inputs), axis=-1)
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, directory: str) -> None:
+        directory = os.path.abspath(directory)
+        os.makedirs(directory, exist_ok=True)
+        meta = {
+            **_model_spec(self.model),
+            "last_timestep": self.last_timestep,
+        }
+        if self.pipeline is not None:
+            _check_registered_tokenizer(self.pipeline)
+            meta["pipeline"] = self.pipeline.spec
+            meta["vocab"] = self.pipeline.vocab.itos
+        # Params first, metadata last — a failed save can leave an old
+        # params tree behind, but never NEW metadata pointing at OLD params.
+        _overwrite_params(os.path.join(directory, "params"), self.params)
+        with open(os.path.join(directory, "classifier.json"), "w") as fh:
+            json.dump(meta, fh)
+
+    @classmethod
+    def load(cls, directory: str) -> "Classifier":
+        from machine_learning_apache_spark_tpu.train.checkpoint import (
+            load_params,
+        )
+
+        directory = os.path.abspath(directory)
+        with open(os.path.join(directory, "classifier.json")) as fh:
+            meta = json.load(fh)
+        model = _model_from_spec(meta)
+        pipeline = None
+        if "pipeline" in meta:
+            spec = meta["pipeline"]
+            pipeline = TextPipeline(
+                Vocab(meta["vocab"], specials=()),
+                spec["tokenizer"],
+                max_seq_len=spec["max_seq_len"],
+                fixed_len=spec["fixed_len"],
+                add_sos=spec["add_sos"],
+                add_eos=spec["add_eos"],
+            )
+        return cls(
+            model,
+            load_params(os.path.join(directory, "params")),
+            pipeline=pipeline,
+            last_timestep=meta["last_timestep"],
+        )
+
+
 class Translator:
     """Trained MT model + its tokenize/detokenize pipelines, callable on
     raw strings. Decoding method per call: ``"greedy"`` (default, KV-cache),
@@ -111,36 +295,12 @@ class Translator:
     def save(self, directory: str) -> None:
         """One directory = one deployable model: params (orbax) + config +
         both vocab/pipeline specs."""
-        from machine_learning_apache_spark_tpu.train.checkpoint import (
-            save_params,
-        )
-
-        from machine_learning_apache_spark_tpu.data.text import get_tokenizer
-
         directory = os.path.abspath(directory)
         os.makedirs(directory, exist_ok=True)
+        # Fail at save time, not at load time with the model already
+        # persisted unrecoverably.
         for pipe in (self.src_pipe, self.trg_pipe):
-            # Fail at save time, not at load time with the model already
-            # persisted unrecoverably: the recorded tokenizer name must
-            # resolve from the registry on a fresh process — and to the
-            # SAME callable this pipeline used (a custom function whose
-            # __name__ shadows a registry key would be silently swapped
-            # for the built-in on load, tokenizing differently).
-            name = pipe.spec["tokenizer"]
-            try:
-                resolved = get_tokenizer(name)
-            except Exception as e:
-                raise ValueError(
-                    f"tokenizer {name!r} is not a registered name; "
-                    "Translator.save requires pipelines built with a "
-                    "registry tokenizer so load() can rebuild them"
-                ) from e
-            if resolved is not pipe.tokenizer:
-                raise ValueError(
-                    f"tokenizer {name!r} resolves to a different callable "
-                    "than this pipeline uses; register the custom "
-                    "tokenizer under its own name before saving"
-                )
+            _check_registered_tokenizer(pipe)
         cfg = dataclasses.asdict(self.model.cfg)
         cfg["dtype"] = jnp.dtype(cfg["dtype"]).name
         meta = {
@@ -150,15 +310,10 @@ class Translator:
             "src_pipe": self.src_pipe.spec,
             "trg_pipe": self.trg_pipe.spec,
         }
-        # Params first (orbax refuses to overwrite: clear a stale tree), the
-        # metadata last — a failed save can leave an old params tree behind,
-        # but never a NEW translator.json pointing at OLD params.
-        params_path = os.path.join(directory, "params")
-        if os.path.exists(params_path):
-            import shutil
-
-            shutil.rmtree(params_path)
-        save_params(params_path, self.params)
+        # Params first, metadata last — a failed save can leave an old
+        # params tree behind, but never a NEW translator.json pointing at
+        # OLD params.
+        _overwrite_params(os.path.join(directory, "params"), self.params)
         with open(os.path.join(directory, "translator.json"), "w") as fh:
             json.dump(meta, fh)
 

@@ -65,7 +65,12 @@ class CNNRecipe:
     metrics_path: str | None = None
 
 
-def train_cnn(recipe: CNNRecipe | None = None, **overrides) -> dict:
+def train_cnn(
+    recipe: CNNRecipe | None = None,
+    *,
+    _return_classifier: bool = False,
+    **overrides,
+) -> dict:
     r = with_overrides(recipe or CNNRecipe(), overrides)
 
     if r.data_root:
@@ -121,4 +126,9 @@ def train_cnn(recipe: CNNRecipe | None = None, **overrides) -> dict:
         mesh=mesh,
     )
     extra = {"resumed_from_step": resumed} if resumed is not None else {}
-    return summarize(result, metrics, **extra)
+    out = summarize(result, metrics, **extra)
+    if _return_classifier:
+        from machine_learning_apache_spark_tpu.inference import Classifier
+
+        out["classifier"] = Classifier(model, result.state.params)
+    return out

@@ -67,7 +67,12 @@ class LSTMRecipe:
     metrics_path: str | None = None
 
 
-def train_lstm(recipe: LSTMRecipe | None = None, **overrides) -> dict:
+def train_lstm(
+    recipe: LSTMRecipe | None = None,
+    *,
+    _return_classifier: bool = False,
+    **overrides,
+) -> dict:
     r = with_overrides(recipe or LSTMRecipe(), overrides)
 
     if r.data_root:
@@ -134,4 +139,11 @@ def train_lstm(recipe: LSTMRecipe | None = None, **overrides) -> dict:
         mesh=mesh,
     )
     extra = {"resumed_from_step": resumed} if resumed is not None else {}
-    return summarize(result, metrics, vocab_size=len(pipe.vocab), **extra)
+    out = summarize(result, metrics, vocab_size=len(pipe.vocab), **extra)
+    if _return_classifier:
+        from machine_learning_apache_spark_tpu.inference import Classifier
+
+        out["classifier"] = Classifier(
+            model, result.state.params, pipeline=pipe, last_timestep=True
+        )
+    return out

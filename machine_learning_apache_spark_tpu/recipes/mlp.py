@@ -60,7 +60,12 @@ class MLPRecipe:
     metrics_path: str | None = None
 
 
-def train_mlp(recipe: MLPRecipe | None = None, **overrides) -> dict:
+def train_mlp(
+    recipe: MLPRecipe | None = None,
+    *,
+    _return_classifier: bool = False,
+    **overrides,
+) -> dict:
     """Run the MLP workload end to end; returns the metric dict."""
     r = with_overrides(recipe or MLPRecipe(), overrides)
 
@@ -115,4 +120,9 @@ def train_mlp(recipe: MLPRecipe | None = None, **overrides) -> dict:
         mesh=mesh,
     )
     extra = {"resumed_from_step": resumed} if resumed is not None else {}
-    return summarize(result, metrics, **extra)
+    out = summarize(result, metrics, **extra)
+    if _return_classifier:
+        from machine_learning_apache_spark_tpu.inference import Classifier
+
+        out["classifier"] = Classifier(model, result.state.params)
+    return out

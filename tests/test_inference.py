@@ -1,12 +1,12 @@
-"""inference.Translator: raw-string translation over a trained model, with
-save/load round-trip — the deployment story the reference lacks (it trains
-and discards, quirk Q7 / SURVEY.md §5)."""
+"""inference.Translator / inference.Classifier: raw-input prediction over
+trained models, with save/load round-trips — the deployment story the
+reference lacks (it trains and discards, quirk Q7 / SURVEY.md §5)."""
 
 import jax
 import numpy as np
 import pytest
 
-from machine_learning_apache_spark_tpu.inference import Translator
+from machine_learning_apache_spark_tpu.inference import Classifier, Translator
 from machine_learning_apache_spark_tpu.recipes.translation import train_translator
 
 
@@ -106,3 +106,69 @@ class TestTranslator:
         )
         with pytest.raises(ValueError, match="different callable"):
             broken.save(str(tmp_path / "shadow"))
+
+
+class TestClassifier:
+    def test_mlp_predict_and_round_trip(self, tmp_path):
+        from machine_learning_apache_spark_tpu.data.datasets import (
+            synthetic_multiclass,
+        )
+        from machine_learning_apache_spark_tpu.recipes.mlp import train_mlp
+
+        # the sigmoid MLP at SGD(0.03) learns slowly: the known-good recipe
+        # config (cf. TestMLPRecipe) reaches >55% at 250 epochs
+        out = train_mlp(
+            epochs=250, synthetic_n=480, batch_size=8, _return_classifier=True
+        )
+        clf = out["classifier"]
+        frame = synthetic_multiclass(480, num_features=4, num_classes=3, seed=1234)
+        feats, labels = frame.arrays()
+        preds = np.asarray(clf.predict(feats))
+        acc = (preds == np.asarray(labels)).mean() * 100
+        # the classifier must track the recipe's own reported accuracy
+        assert acc > out["accuracy"] - 10.0, (acc, out["accuracy"])
+        assert acc > 50.0, acc
+        probs = np.asarray(clf.predict_proba(feats[:5]))
+        np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-5)
+
+        clf.save(str(tmp_path / "mlp"))
+        clf2 = Classifier.load(str(tmp_path / "mlp"))
+        np.testing.assert_array_equal(
+            np.asarray(clf2.predict(feats[:20])), preds[:20]
+        )
+
+    def test_lstm_predicts_raw_strings(self, tmp_path):
+        from machine_learning_apache_spark_tpu.data.datasets import (
+            synthetic_text_classification,
+        )
+        from machine_learning_apache_spark_tpu.recipes.lstm import train_lstm
+
+        out = train_lstm(
+            epochs=2, synthetic_n=512, batch_size=16, max_seq_len=24,
+            _return_classifier=True,
+        )
+        clf = out["classifier"]
+        texts, labels = synthetic_text_classification(64, num_classes=4, seed=0)
+        preds = np.asarray(clf.predict(texts))  # raw strings in
+        assert preds.shape == (64,)
+        acc = (preds == np.asarray(labels)).mean() * 100
+        assert acc > 30.0, acc  # beats 4-class chance
+
+        clf.save(str(tmp_path / "lstm"))
+        clf2 = Classifier.load(str(tmp_path / "lstm"))
+        np.testing.assert_array_equal(
+            np.asarray(clf2.predict(texts[:10])), preds[:10]
+        )
+        assert clf2.last_timestep and clf2.pipeline is not None
+
+    def test_cnn_classifier_batched(self):
+        from machine_learning_apache_spark_tpu.recipes.cnn import train_cnn
+
+        out = train_cnn(
+            epochs=1, synthetic_n=256, batch_size=16, hidden_units=4,
+            _return_classifier=True,
+        )
+        clf = out["classifier"]
+        clf.batch_size = 100  # forces a ragged chunked predict
+        x = np.random.default_rng(0).normal(size=(256, 28, 28, 1)).astype("float32")
+        assert np.asarray(clf.predict(x)).shape == (256,)
