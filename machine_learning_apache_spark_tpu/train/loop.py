@@ -86,6 +86,7 @@ def fit(
     profile_dir: str | None = None,
     profile_window: tuple[int, int] = (2, 5),
     metrics_file: str | None = None,
+    sync_check_every: int = 0,
 ) -> FitResult:
     """The canonical loop (``pytorch_cnn.py:125-146`` shape): epochs × batches,
     per-``log_every``-batch loss/time prints
@@ -108,6 +109,12 @@ def fit(
     ``metrics_file`` appends one JSON line per epoch (and a final run
     record) — the structured counterpart of the reference's print-only
     metrics (SURVEY.md §5 metrics/logging).
+
+    ``sync_check_every=N`` runs ``parallel.assert_replicas_in_sync`` on the
+    params every N epochs — the race-detector analogue for the reference's
+    Q2-class replica-drift bug (SURVEY.md §5), raising if a multi-process
+    gang's replicas diverge. 0 (default) disables the check (it is a
+    cross-host sync point).
 
     The input ``state``'s buffers are CONSUMED (the fused step donates them
     for in-place updates); use ``FitResult.state``, never the argument,
@@ -147,6 +154,7 @@ def fit(
             state, history = _run_epochs(
                 state, step_fn, train_loader, epochs, rng, mesh, log_every,
                 emit, tracer, checkpointer, checkpoint_every, span_timer, sink,
+                sync_check_every,
             )
         finally:
             # An exception mid-window must still stop the (process-global)
@@ -177,6 +185,7 @@ def fit(
 def _run_epochs(
     state, step_fn, train_loader, epochs, rng, mesh, log_every, emit,
     tracer, checkpointer, checkpoint_every, span_timer, sink=None,
+    sync_check_every=0,
 ):
     history: list[dict] = []
     global_step = 0
@@ -219,6 +228,15 @@ def _run_epochs(
             sink.write({"kind": "epoch", "step": int(state.step), **computed})
         if log_every:
             emit(f"epoch {epoch} done | {epoch_metrics.log_line()}")
+        if sync_check_every and (epoch + 1) % sync_check_every == 0:
+            # BEFORE the checkpoint save: a diverged state must raise here,
+            # not get persisted as the latest resumable checkpoint first.
+            from machine_learning_apache_spark_tpu.parallel import (
+                assert_replicas_in_sync,
+            )
+
+            div = assert_replicas_in_sync(state.params)
+            emit(f"epoch {epoch} replica divergence: {div:.3g}")
         if checkpointer is not None and (
             (epoch + 1) % max(checkpoint_every, 1) == 0 or epoch == epochs - 1
         ):

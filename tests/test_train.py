@@ -106,6 +106,24 @@ class TestFitMLP:
         )
         assert metrics["eval_samples"] == 37
 
+    def test_sync_check_flag(self, rng):
+        """sync_check_every wires the replica-divergence race detector into
+        the loop (trivially 0.0 single-process; the 2-process gang test
+        exercises the cross-process path)."""
+        feats, labels = _synthetic_classification(rng, n=60)
+        model = MLP(layers=(4, 5, 4, 3))
+        params = model.init(jax.random.key(0), feats[:1])["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.03)
+        )
+        lines = []
+        fit(
+            state, classification_loss(model.apply),
+            _batches(feats, labels, 30),
+            epochs=4, log_every=0, sync_check_every=2, emit=lines.append,
+        )
+        assert sum("replica divergence" in l for l in lines) == 2
+
     def test_step_counter_advances(self, rng):
         feats, labels = _synthetic_classification(rng, n=30)
         model = MLP(layers=(4, 5, 4, 3))
