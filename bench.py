@@ -107,8 +107,19 @@ def _init_backend():
     if os.environ.get("BENCH_PLATFORM"):  # e.g. "cpu" for smoke runs
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     else:
+        # The tunneled dev chip comes and goes: retry the probe a few times
+        # (fresh subprocess each attempt) before surrendering to CPU, so a
+        # transient outage at probe time doesn't cost the round's only TPU
+        # measurement. Worst case is retries × timeout before fallback.
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
-        if not _probe_default_backend(probe_timeout):
+        retries = max(int(os.environ.get("BENCH_PROBE_RETRIES", "2")), 1)
+        for attempt in range(retries):
+            if _probe_default_backend(probe_timeout):
+                break
+            log(f"backend probe attempt {attempt + 1}/{retries} failed")
+            if attempt < retries - 1:  # no pointless sleep before fallback
+                time.sleep(min(10.0 * (attempt + 1), 30.0))
+        else:
             log("default backend unusable (see probe log); falling back to CPU")
             jax.config.update("jax_platforms", "cpu")
     try:
