@@ -111,15 +111,22 @@ def with_overrides(recipe, overrides: dict):
     return dataclasses.replace(recipe, **overrides) if overrides else recipe
 
 
+def local_batch_scale(mesh) -> int:
+    """Per-process multiplier turning a per-replica batch into this
+    process's share of the global batch (``data`` axis size / processes) —
+    the single sizing contract for every loader (fixed-width or bucketed)."""
+    return mesh.shape[DATA_AXIS] // jax.process_count() if mesh is not None else 1
+
+
 def make_loaders(
-    train_ds: ArrayDataset,
+    train_ds: ArrayDataset | None,
     test_ds: ArrayDataset | None,
     *,
     batch_size: int,
     mesh,
     seed: int = 0,
     collate: Callable[[tuple], Any] | None = None,
-) -> tuple[DataLoader, DataLoader | None]:
+) -> tuple[DataLoader | None, DataLoader | None]:
     """Reference loader semantics, mesh-aware.
 
     The reference keeps ``batch_size`` **per replica** and shards the
@@ -139,8 +146,7 @@ def make_loaders(
     (see ``train.loop.evaluate``).
     """
     world = jax.process_count()
-    data_size = mesh.shape[DATA_AXIS] if mesh is not None else 1
-    local_scale = data_size // world if mesh is not None else 1
+    local_scale = local_batch_scale(mesh)
 
     def _clamped(n_rows: int, want: int, split: str) -> int:
         """Largest mesh-divisible batch ≤ want that ``n_rows`` can fill at
@@ -162,22 +168,25 @@ def make_loaders(
             )
         return min(want, largest)
 
-    sampler = None
-    if world > 1:
-        sampler = DistributedSampler(len(train_ds), seed=seed)
-    n_train = len(sampler) if sampler is not None else len(train_ds)
-    train_loader = DataLoader(
-        train_ds,
-        _clamped(n_train, batch_size * local_scale, "train"),
-        shuffle=sampler is None,
-        sampler=sampler,
-        drop_last=True,
-        seed=seed,
-        collate=collate,
-        # Assemble ahead on a background thread: the jitted step dispatches
-        # async, so the device trains while the host gathers/collates.
-        prefetch=2,
-    )
+    train_loader = None
+    if train_ds is not None:  # None: caller brings its own (e.g. bucketed)
+        sampler = None
+        if world > 1:
+            sampler = DistributedSampler(len(train_ds), seed=seed)
+        n_train = len(sampler) if sampler is not None else len(train_ds)
+        train_loader = DataLoader(
+            train_ds,
+            _clamped(n_train, batch_size * local_scale, "train"),
+            shuffle=sampler is None,
+            sampler=sampler,
+            drop_last=True,
+            seed=seed,
+            collate=collate,
+            # Assemble ahead on a background thread: the jitted step
+            # dispatches async, so the device trains while the host
+            # gathers/collates.
+            prefetch=2,
+        )
     test_loader = None
     if test_ds is not None:
         test_sampler = (
