@@ -20,12 +20,14 @@ from machine_learning_apache_spark_tpu.ops.masks import (
 )
 from machine_learning_apache_spark_tpu.ops.positional import sinusoidal_encoding
 from machine_learning_apache_spark_tpu.ops.attention import (
+    dot_product_attention,
     scaled_dot_product_attention,
     multi_head_attention_weights,
     sequence_parallel,
 )
 
 __all__ = [
+    "dot_product_attention",
     "make_causal_mask",
     "make_padding_mask",
     "make_attention_mask",
