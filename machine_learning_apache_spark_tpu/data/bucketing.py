@@ -71,6 +71,7 @@ class BucketByLengthLoader:
         truncate_overlong: bool = False,
         num_replicas: int | None = None,
         rank: int | None = None,
+        lengths: Sequence[int] | None = None,
     ) -> None:
         if not boundaries:
             raise ValueError("need at least one bucket boundary")
@@ -94,7 +95,15 @@ class BucketByLengthLoader:
         if not (0 <= self.rank < self.num_replicas):
             raise ValueError(f"rank {self.rank} outside [0, {self.num_replicas})")
         self._epoch = 0
-        lengths = np.asarray([len(s) for s in self.sequences])
+        # ``lengths`` overrides the bucketing key (paired loaders bucket by
+        # the max across their streams); padding still uses real row lengths.
+        if lengths is not None and len(lengths) != len(self.sequences):
+            raise ValueError(
+                f"lengths ({len(lengths)}) != sequences ({len(self.sequences)})"
+            )
+        lengths = np.asarray(
+            [len(s) for s in self.sequences] if lengths is None else lengths
+        )
         longest = int(lengths.max(initial=0))
         if longest > self.boundaries[-1] and not truncate_overlong:
             raise ValueError(
@@ -164,4 +173,64 @@ class BucketByLengthLoader:
             width = self.boundaries[b]
             real += sum(min(len(self.sequences[i]), width) for i in idx)
             padded += len(idx) * width
+        return real / padded if padded else 1.0
+
+
+class BucketByLengthPairsLoader(BucketByLengthLoader):
+    """Paired-stream bucketing for translation: each (src, trg) pair lands
+    in the smallest boundary that fits ``max(len(src), len(trg) - 1)``, src
+    pads to the boundary and trg to ``boundary + 1`` (so the teacher-forced
+    decoder input ``trg[:, :-1]`` is boundary-wide) — the SURVEY.md §7
+    recommendation: keep XLA's static shapes (one program per bucket) but
+    stop paying corpus-max attention FLOPs on short sentence pairs.
+
+    Yields ``(src_ids[B, b], trg_ids[B, b + 1], *extras)`` batches.
+    """
+
+    def __init__(
+        self,
+        src_sequences: Sequence[Sequence[int]],
+        trg_sequences: Sequence[Sequence[int]],
+        *extras: np.ndarray,
+        **kwargs,
+    ) -> None:
+        if len(src_sequences) != len(trg_sequences):
+            raise ValueError(
+                f"{len(src_sequences)} src vs {len(trg_sequences)} trg rows"
+            )
+        self.trg_sequences = [list(t) for t in trg_sequences]
+        kwargs.setdefault(
+            "lengths",
+            [
+                max(len(s), len(t) - 1)
+                for s, t in zip(src_sequences, trg_sequences)
+            ],
+        )
+        super().__init__(src_sequences, *extras, **kwargs)
+
+    def _pad_trg(self, idx: np.ndarray, width: int) -> np.ndarray:
+        rows = PadToLength(width, self.pad_id)(
+            [self.trg_sequences[i] for i in idx]
+        )
+        return np.asarray(rows, dtype=np.int32)
+
+    def __iter__(self):
+        for b, idx in self._schedule(self._epoch):
+            width = self.boundaries[b]
+            yield (
+                self._pad(idx, width),
+                self._pad_trg(idx, width + 1),
+                *(e[idx] for e in self.extras),
+            )
+
+    @property
+    def padding_efficiency(self) -> float:
+        """Across BOTH streams (src slots + trg slots)."""
+        real = padded = 0
+        for b, idx in self._schedule(self._epoch):
+            width = self.boundaries[b]
+            for i in idx:
+                real += min(len(self.sequences[i]), width)
+                real += min(len(self.trg_sequences[i]), width + 1)
+            padded += len(idx) * (2 * width + 1)
         return real / padded if padded else 1.0

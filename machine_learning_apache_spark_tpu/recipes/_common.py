@@ -111,6 +111,37 @@ def with_overrides(recipe, overrides: dict):
     return dataclasses.replace(recipe, **overrides) if overrides else recipe
 
 
+def make_bucketed_loader(
+    loader_cls,
+    *streams,
+    batch_size: int,
+    mesh,
+    full_width: int,
+    boundaries: tuple[int, ...] = (),
+    seed: int = 0,
+):
+    """Shared bucketed-loader construction for recipes: default boundaries
+    at (1/4, 1/2, full) of the fixed width, per-replica batch scaled to the
+    mesh's local share, and a loud error when the effective batch leaves
+    every bucket short of one full batch (``drop_last`` inside each bucket
+    would otherwise "train" on zero batches)."""
+    boundaries = boundaries or tuple(
+        sorted({max(full_width // 4, 8), max(full_width // 2, 8), full_width})
+    )
+    effective = batch_size * local_batch_scale(mesh)
+    loader = loader_cls(
+        *streams, batch_size=effective, boundaries=boundaries, seed=seed
+    )
+    if len(loader) == 0:
+        raise ValueError(
+            f"effective batch {effective} (batch_size={batch_size} × "
+            f"{local_batch_scale(mesh)} local replicas) leaves every length "
+            f"bucket ({boundaries}) short of one full batch; shrink the "
+            "batch or provide more data"
+        )
+    return loader
+
+
 def local_batch_scale(mesh) -> int:
     """Per-process multiplier turning a per-replica batch into this
     process's share of the global batch (``data`` axis size / processes) —

@@ -112,36 +112,25 @@ def train_lstm(
         seed=r.seed,
     )
     if r.bucket_by_length:
-        # Bucket-padded ragged batches for TRAINING. Batch sizing shares
-        # make_loaders' contract (per-replica batch × local data-axis
-        # share) so the assembled global batch divides the mesh.
+        # Bucket-padded ragged batches for TRAINING (shared construction:
+        # default boundaries, mesh-scaled batch, loud zero-batch guard).
         from machine_learning_apache_spark_tpu.data.bucketing import (
             BucketByLengthLoader,
         )
         from machine_learning_apache_spark_tpu.recipes._common import (
-            local_batch_scale,
+            make_bucketed_loader,
         )
 
-        full = r.max_seq_len + 1  # the pipeline's fixed width (incl. eos)
-        boundaries = r.bucket_boundaries or tuple(
-            sorted({max(full // 4, 8), max(full // 2, 8), full})
-        )
-        train_loader = BucketByLengthLoader(
+        train_loader = make_bucketed_loader(
+            BucketByLengthLoader,
             pipe.ragged(train_texts),
             train_labels,
-            batch_size=r.batch_size * local_batch_scale(mesh),
-            boundaries=boundaries,
+            batch_size=r.batch_size,
+            mesh=mesh,
+            full_width=r.max_seq_len + 1,  # the fixed width (incl. eos)
+            boundaries=r.bucket_boundaries,
             seed=r.seed,
         )
-        if len(train_loader) == 0:
-            # drop_last inside each bucket: a batch larger than every
-            # bucket's membership would "train" on zero batches — fail as
-            # loudly as make_loaders' clamp does on the fixed-width path.
-            raise ValueError(
-                f"batch_size={r.batch_size} leaves every length bucket "
-                f"({boundaries}) short of one full batch; shrink the batch "
-                "or provide more data"
-            )
     else:
         train_loader = fixed_train
 

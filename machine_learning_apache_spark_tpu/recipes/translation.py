@@ -98,6 +98,12 @@ class TranslationRecipe:
     # Structured observability: append per-epoch + end-of-run JSON lines
     # (train.metrics.MetricsLogger) alongside the print vocabulary.
     metrics_path: str | None = None
+    # Paired length-bucketed TRAINING batches (SURVEY.md §7: keep XLA's
+    # static shapes but stop paying corpus-max attention FLOPs on short
+    # sentence pairs). Eval keeps the fixed width. Incompatible with
+    # sequence_parallel (the ring needs one divisible length).
+    bucket_by_length: bool = False
+    bucket_boundaries: tuple[int, ...] = ()  # () → (1/4, 1/2, full) of max_len
 
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
@@ -202,15 +208,44 @@ def train_translator(
         raise ValueError(
             f"expert_parallel={r.expert_parallel} requires moe_experts > 0"
         )
+    if r.bucket_by_length and r.sequence_parallel > 1:
+        raise ValueError(
+            "bucket_by_length is incompatible with sequence_parallel: the "
+            "ring needs one fixed seq-axis-divisible length"
+        )
     mesh = resolve_mesh(
         r.use_mesh,
         model_parallel=r.model_parallel,
         sequence_parallel=r.sequence_parallel,
         expert_parallel=r.expert_parallel,
     )
+    # Under bucketing the fixed-width train loader is never used: build only
+    # the eval loader (full-coverage contract keeps the fixed width).
     train_loader, val_loader = make_loaders(
-        train_ds, val_ds, batch_size=r.batch_size, mesh=mesh, seed=r.seed
+        None if r.bucket_by_length else train_ds,
+        val_ds,
+        batch_size=r.batch_size,
+        mesh=mesh,
+        seed=r.seed,
     )
+    if r.bucket_by_length:
+        from machine_learning_apache_spark_tpu.data.bucketing import (
+            BucketByLengthPairsLoader,
+        )
+        from machine_learning_apache_spark_tpu.recipes._common import (
+            make_bucketed_loader,
+        )
+
+        train_loader = make_bucketed_loader(
+            BucketByLengthPairsLoader,
+            src_pipe.ragged([s for s, _ in pairs]),
+            trg_pipe.ragged([t for _, t in pairs]),
+            batch_size=r.batch_size,
+            mesh=mesh,
+            full_width=r.max_len,
+            boundaries=r.bucket_boundaries,
+            seed=r.seed,
+        )
 
     src0, trg0 = train_ds[:2]
     params = model.init(jax.random.key(r.seed), src0, trg0[:, :-1])["params"]
@@ -306,6 +341,8 @@ def train_translator(
     extra: dict = {}
     if resumed is not None:
         extra["resumed_from_step"] = resumed
+    if r.bucket_by_length:
+        extra["padding_efficiency"] = train_loader.padding_efficiency
     if r.compute_bleu and val_loader is not None:
         from machine_learning_apache_spark_tpu.data.text import EOS_ID, SOS_ID
         from machine_learning_apache_spark_tpu.models.transformer import (

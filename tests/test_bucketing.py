@@ -121,3 +121,72 @@ class TestLoader:
             lens.append((len(loader), sum(1 for _ in loader)))
         assert lens[0] == lens[1]
         assert lens[0][0] == lens[0][1] == 3  # ceil(11/2)=6 → 3 batches
+
+
+class TestPairsLoader:
+    """BucketByLengthPairsLoader — paired src/trg bucketing for MT
+    (SURVEY.md §7: bucket by length to not waste pod FLOPs)."""
+
+    def _make(self, **kw):
+        from machine_learning_apache_spark_tpu.data.bucketing import (
+            BucketByLengthPairsLoader,
+        )
+
+        rng = np.random.default_rng(0)
+        src = [[5] * int(n) for n in rng.integers(3, 30, 64)]
+        trg = [[1] + [6] * int(n) + [2] for n in rng.integers(2, 28, 64)]
+        kw.setdefault("batch_size", 8)
+        kw.setdefault("boundaries", (8, 16, 32))
+        return BucketByLengthPairsLoader(src, trg, **kw), src, trg
+
+    def test_shapes_and_bucket_key(self):
+        loader, src, trg = self._make(shuffle=False)
+        seen = set()
+        for s, t in loader:
+            assert t.shape[1] == s.shape[1] + 1  # trg one wider (sos shift)
+            assert s.shape[1] in (8, 16, 32)
+            seen.add(s.shape[1])
+        assert len(seen) > 1  # multiple buckets actually exercised
+
+    def test_nothing_silently_clipped(self):
+        """Every padded row keeps ALL its real tokens — a bucketing-key
+        regression that put a long pair in a short bucket would clip."""
+        loader, src, trg = self._make(shuffle=False, drop_last=False)
+        for b, idx in loader._schedule(0):
+            width = loader.boundaries[b]
+            s = loader._pad(idx, width)
+            t = loader._pad_trg(idx, width + 1)
+            for row_s, row_t, i in zip(s, t, idx):
+                # src rows are all-5s, trg all non-zero: non-pad count must
+                # equal the original length
+                assert int((row_s != 0).sum()) == len(src[i])
+                assert int((row_t != 0).sum()) == len(trg[i])
+
+    def test_pair_buckets_by_max_stream(self):
+        from machine_learning_apache_spark_tpu.data.bucketing import (
+            BucketByLengthPairsLoader,
+        )
+
+        # short src, long trg: the PAIR must land in the bucket fitting trg
+        src = [[5, 5]] * 8
+        trg = [[1] + [6] * 20 + [2]] * 8  # len 22 → key 21 → bucket 32
+        loader = BucketByLengthPairsLoader(
+            src, trg, batch_size=8, boundaries=(8, 16, 32), shuffle=False
+        )
+        (s, t), = list(loader)
+        assert s.shape == (8, 32) and t.shape == (8, 33)
+
+    def test_length_mismatch_raises(self):
+        from machine_learning_apache_spark_tpu.data.bucketing import (
+            BucketByLengthPairsLoader,
+        )
+
+        with pytest.raises(ValueError, match="src vs"):
+            BucketByLengthPairsLoader(
+                [[1]], [[1], [2]], batch_size=1, boundaries=(8,)
+            )
+
+    def test_padding_efficiency_counts_both_streams(self):
+        loader, src, trg = self._make(shuffle=False, drop_last=False)
+        eff = loader.padding_efficiency
+        assert 0.0 < eff < 1.0
