@@ -218,6 +218,44 @@ class TestRecipeResume:
         assert "resumed_from_step" not in third
 
 
+class TestShardedResume:
+    """Resume under tensor+expert parallelism: the restore template is
+    unboxed to match what fit saves, then restored values are grafted back
+    into the Flax Partitioned boxes — so the SECOND run's shard_state must
+    still see the logical annotations and lay the restored weights out
+    TP/EP-sharded, not silently replicated."""
+
+    def test_tp_ep_resume_keeps_sharding(self, tmp_path):
+        import math
+
+        from machine_learning_apache_spark_tpu.parallel.mesh import (
+            EXPERT_AXIS,
+            MODEL_AXIS,
+        )
+        from machine_learning_apache_spark_tpu.recipes.translation import (
+            train_translator,
+        )
+
+        kw = dict(
+            epochs=1, synthetic_n=128, batch_size=8, max_len=16,
+            d_model=32, ffn_hidden=64, num_heads=4, log_every=0,
+            model_parallel=2, moe_experts=4, expert_parallel=2,
+            checkpoint_dir=str(tmp_path / "tp_ep"),
+        )
+        first = train_translator(**kw)
+        assert "resumed_from_step" not in first
+        second = train_translator(**kw, _return_state=True)
+        assert second["resumed_from_step"] > 0
+        params = second["state"].params
+        # attention QKV stays model-sharded after restore + refit
+        qkv = params["encoder"]["layer_0"]["self_attn"]["qkv"]["kernel"]
+        assert MODEL_AXIS in jax.tree.leaves(tuple(qkv.sharding.spec))
+        # MoE expert weights stay expert-sharded
+        w_up = params["encoder"]["layer_0"]["ffn"]["w_up"]
+        assert EXPERT_AXIS in jax.tree.leaves(tuple(w_up.sharding.spec))
+        assert math.isfinite(second["final_loss"])
+
+
 class TestParamsOnly:
     def test_save_load(self, tmp_path):
         state = make_state()
