@@ -307,9 +307,17 @@ def open_checkpointing(
     return mgr, state, resumed
 
 
-def summarize(fit_result, eval_metrics: dict | None, **extra) -> dict:
+def summarize(
+    fit_result, eval_metrics: dict | None, *, metrics_path: str | None = None,
+    **extra,
+) -> dict:
     """The printable/picklable end-of-run contract — the reference's metric
-    vocabulary (SURVEY.md §5: train wall-time, losses, accuracy %)."""
+    vocabulary (SURVEY.md §5: train wall-time, losses, accuracy %).
+
+    ``metrics_path`` appends one ``{"kind": "eval", ...}`` JSON line so the
+    sink that recorded the training epochs also records how the run scored
+    (rank-0 gated like the in-loop records).
+    """
     out = {
         "train_seconds": fit_result.train_seconds,
         "final_loss": fit_result.final_loss,
@@ -321,4 +329,17 @@ def summarize(fit_result, eval_metrics: dict | None, **extra) -> dict:
     if eval_metrics:
         out.update(eval_metrics)
     out.update(extra)
+    if metrics_path and eval_metrics and jax.process_index() == 0:
+        from machine_learning_apache_spark_tpu.train.metrics import (
+            MetricsLogger,
+        )
+
+        # The scalar extras too (bleu, padding_efficiency, resumed step,
+        # vocab sizes): the eval record is "how the run scored", not just
+        # the loss/accuracy pair.
+        scalars = {
+            k: v for k, v in extra.items() if isinstance(v, (int, float, str))
+        }
+        with MetricsLogger(metrics_path) as sink:
+            sink.write({"kind": "eval", **eval_metrics, **scalars})
     return out

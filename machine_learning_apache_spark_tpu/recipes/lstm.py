@@ -177,7 +177,10 @@ def train_lstm(
         # real tokens / padded slots over the epoch — the FLOP-waste metric
         # bucketing improves (fixed-width padding scores far lower).
         extra["padding_efficiency"] = train_loader.padding_efficiency
-    out = summarize(result, metrics, vocab_size=len(pipe.vocab), **extra)
+    out = summarize(
+        result, metrics, metrics_path=r.metrics_path,
+        vocab_size=len(pipe.vocab), **extra,
+    )
     if _return_classifier:
         from machine_learning_apache_spark_tpu.inference import Classifier
 

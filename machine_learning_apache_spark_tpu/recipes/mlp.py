@@ -120,7 +120,7 @@ def train_mlp(
         mesh=mesh,
     )
     extra = {"resumed_from_step": resumed} if resumed is not None else {}
-    out = summarize(result, metrics, **extra)
+    out = summarize(result, metrics, metrics_path=r.metrics_path, **extra)
     if _return_classifier:
         from machine_learning_apache_spark_tpu.inference import Classifier
 

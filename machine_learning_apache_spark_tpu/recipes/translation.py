@@ -374,6 +374,7 @@ def train_translator(
     out = summarize(
         result,
         metrics,
+        metrics_path=r.metrics_path,
         src_vocab=len(src_pipe.vocab),
         trg_vocab=len(trg_pipe.vocab),
         **extra,

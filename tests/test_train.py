@@ -261,6 +261,9 @@ class TestMetricsLogger:
         train_mlp(epochs=2, synthetic_n=120, metrics_path=path)
         records = MetricsLogger.read(path)
         assert len([r for r in records if r["kind"] == "run"]) == 2
+        # eval results land in the same sink (one per run)
+        evals = [r for r in records if r["kind"] == "eval"]
+        assert len(evals) == 2 and all("accuracy" in r for r in evals)
 
 
 class TestFitCNN:
