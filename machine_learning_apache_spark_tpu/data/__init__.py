@@ -22,6 +22,7 @@ from machine_learning_apache_spark_tpu.data.text import (
     Vocab,
     classification_pipeline,
     get_tokenizer,
+    register_tokenizer,
     translation_pipelines,
 )
 from machine_learning_apache_spark_tpu.data.datasets import (
@@ -58,5 +59,6 @@ __all__ = [
     "assign_buckets",
     "classification_pipeline",
     "get_tokenizer",
+    "register_tokenizer",
     "translation_pipelines",
 ]

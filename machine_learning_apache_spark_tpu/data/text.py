@@ -83,6 +83,28 @@ _TOKENIZERS: dict[str, Callable[[str], list[str]]] = {
 }
 
 
+def register_tokenizer(
+    name: str, fn: Callable[[str], list[str]], *, overwrite: bool = False
+) -> None:
+    """Register a custom tokenizer under ``name`` so pipelines built with it
+    reconstruct by name — the requirement ``inference.Translator.save`` /
+    ``Classifier.save`` enforce (a bare callable cannot be rebuilt by
+    ``load()`` in a fresh process; re-register before loading there too).
+
+    Shadowing a built-in (or an earlier registration) raises unless
+    ``overwrite=True`` — a silent swap would tokenize differently than the
+    vocab was built with.
+    """
+    if not callable(fn):
+        raise TypeError(f"tokenizer must be callable, got {fn!r}")
+    if name in _TOKENIZERS and _TOKENIZERS[name] is not fn and not overwrite:
+        raise ValueError(
+            f"tokenizer {name!r} is already registered; pass overwrite=True "
+            "to replace it"
+        )
+    _TOKENIZERS[name] = fn
+
+
 def get_tokenizer(name: str | Callable[[str], list[str]]) -> Callable[[str], list[str]]:
     """Resolve a tokenizer by name or pass a callable through — the
     ``torchtext.data.utils.get_tokenizer`` surface."""

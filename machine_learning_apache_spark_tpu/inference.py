@@ -54,13 +54,15 @@ def _check_registered_tokenizer(pipe: TextPipeline) -> None:
         raise ValueError(
             f"tokenizer {name!r} is not a registered name; save requires "
             "pipelines built with a registry tokenizer so load() can "
-            "rebuild them"
+            "rebuild them — register custom callables via "
+            "data.text.register_tokenizer(name, fn) before building the "
+            "pipeline"
         ) from e
     if resolved is not pipe.tokenizer:
         raise ValueError(
             f"tokenizer {name!r} resolves to a different callable than "
             "this pipeline uses; register the custom tokenizer under its "
-            "own name before saving"
+            "own name (data.text.register_tokenizer) before saving"
         )
 
 

@@ -172,3 +172,48 @@ class TestClassifier:
         clf.batch_size = 100  # forces a ragged chunked predict
         x = np.random.default_rng(0).normal(size=(256, 28, 28, 1)).astype("float32")
         assert np.asarray(clf.predict(x)).shape == (256,)
+
+
+class TestRegisteredCustomTokenizer:
+    def test_registered_custom_tokenizer_persists(self, trained, tmp_path):
+        """register_tokenizer closes the loop the save() errors point to: a
+        custom tokenizer registered under its own name saves and loads."""
+        from machine_learning_apache_spark_tpu.data.text import (
+            TextPipeline,
+            register_tokenizer,
+        )
+
+        def upper_split(s):
+            return s.upper().split()
+
+        register_tokenizer("upper_split_test", upper_split)
+        try:
+            t, _ = trained
+            custom = Translator(
+                t.model, t.params,
+                TextPipeline(
+                    t.src_pipe.vocab, "upper_split_test", max_seq_len=9,
+                    fixed_len=10,
+                ),
+                t.trg_pipe,
+            )
+            custom.save(str(tmp_path / "custom"))
+            loaded = Translator.load(str(tmp_path / "custom"))
+            assert loaded.src_pipe.tokenizer is upper_split
+            assert loaded(["a b"]) == custom(["a b"])
+        finally:
+            from machine_learning_apache_spark_tpu.data import text
+
+            text._TOKENIZERS.pop("upper_split_test", None)
+
+    def test_shadowing_builtin_requires_overwrite(self):
+        import pytest as _pytest
+
+        from machine_learning_apache_spark_tpu.data.text import (
+            register_tokenizer,
+        )
+
+        with _pytest.raises(ValueError, match="already registered"):
+            register_tokenizer("word_punct", lambda s: s.split())
+        with _pytest.raises(TypeError, match="callable"):
+            register_tokenizer("not_fn", 42)
