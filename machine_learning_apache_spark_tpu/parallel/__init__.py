@@ -28,6 +28,9 @@ from machine_learning_apache_spark_tpu.parallel.data_parallel import (
 from machine_learning_apache_spark_tpu.parallel.pipeline_parallel import (
     pipeline_apply,
 )
+from machine_learning_apache_spark_tpu.parallel.pipeline_transformer import (
+    pipeline_transformer_logits,
+)
 from machine_learning_apache_spark_tpu.ops.attention import sequence_parallel
 from machine_learning_apache_spark_tpu.parallel.ring_attention import (
     ring_attention,
@@ -58,6 +61,7 @@ __all__ = [
     "pad_batch_to_multiple",
     "params_fingerprint",
     "pipeline_apply",
+    "pipeline_transformer_logits",
     "ring_attention",
     "sequence_parallel",
     "DEFAULT_RULES",

@@ -35,12 +35,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 from machine_learning_apache_spark_tpu.parallel.mesh import PIPELINE_AXIS
 
 
-def _pipeline_shard_fn(stage_params, x, *, stage_fn, n_micro, axis, mesh_axes):
+def _pipeline_shard_fn(
+    stage_params, x, aux, aux_rep, *, stage_fn, n_micro, axis, mesh_axes
+):
     """Per-stage body under shard_map.
 
     ``stage_params``: this stage's params (leading stage dim of size 1,
     squeezed). ``x``: the full batch (replicated across stages),
-    ``[n_micro, micro_batch, ...]``.
+    ``[n_micro, micro_batch, ...]``. ``aux``/``aux_rep``: optional pytrees
+    of per-microbatch constants (leaves ``[n_micro, ...]``; ``aux`` is
+    per-example and data-sharded, ``aux_rep`` replicated); stage s at tick
+    t is processing microbatch t−s, so it receives that microbatch's aux
+    slices alongside the activations.
     """
     n_stages = jax.lax.psum(1, axis)
     stage_id = jax.lax.axis_index(axis)
@@ -62,7 +68,19 @@ def _pipeline_shard_fn(stage_params, x, *, stage_fn, n_micro, axis, mesh_axes):
             x, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
         )
         inp = jnp.where(stage_id == 0, feed, state)
-        out = stage_fn(params, inp)
+        if aux is None and aux_rep is None:
+            out = stage_fn(params, inp)
+        else:
+            # The microbatch THIS stage is processing now (clipped during
+            # warmup/drain ticks, whose garbage compute is discarded below).
+            mb = jnp.clip(t - stage_id, 0, n_micro - 1)
+            index = lambda tree: jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, mb, axis=0, keepdims=False
+                ),
+                tree,
+            )
+            out = stage_fn(params, inp, index(aux), index(aux_rep), stage_id, t)
         # Microbatch m = t - stage_id finished the last stage at this tick.
         m = t - stage_id
         valid = (m >= 0) & (m < n_micro)
@@ -97,20 +115,40 @@ def pipeline_apply(
     *,
     n_micro: int | None = None,
     axis: str = PIPELINE_AXIS,
+    aux=None,
+    aux_replicated=None,
 ) -> jnp.ndarray:
     """Run ``x`` through ``n_stages`` sequential applications of
     ``stage_fn``, pipelined over the mesh's ``axis``.
 
     - ``stage_fn(params, x) -> y`` with ``y.shape == x.shape`` (homogeneous
       stack; the residual-block contract of the zoo Transformer's layers).
+      With ``aux``/``aux_replicated``, the contract widens to
+      ``stage_fn(params, x, aux_m, rep_m, stage_id, tick) -> y`` where
+      ``aux_m``/``rep_m`` are the current microbatch's slices.
     - ``stage_params``: pytree whose leaves carry a leading stage dimension
       of size ``mesh.shape[axis]`` (stage i uses slice i).
     - ``x``: ``[batch, ...]``; split into ``n_micro`` microbatches (defaults
       to the stage count — more microbatches, smaller bubble).
+    - ``aux``: optional pytree of per-example constants (each leaf
+      ``[batch, ...]`` — e.g. attention validity masks, encoder memory);
+      microbatched alongside ``x`` and handed to the stage processing that
+      microbatch.
+    - ``aux_replicated``: optional pytree of per-MICROBATCH constants
+      (leaves ``[n_micro, ...]``, e.g. dropout rng key data) that ride
+      replicated — never sharded over the data axis.
+
+    Composes with data parallelism: on a mesh that also carries a ``"data"``
+    axis, the microbatch dim of ``x``/``aux`` is sharded over it and the
+    stages' compute runs on each data shard independently (activations cross
+    only the pipeline axis). Other nontrivial mesh axes are rejected —
+    TP/SP inside a pipeline stage is out of scope.
 
     Returns ``stage_fn^(n_stages)(x)`` exactly — parity with the sequential
     loop is pinned by ``tests/test_pipeline_parallel.py``.
     """
+    from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+
     n_stages = mesh.shape[axis]
     n_micro = n_micro or n_stages
     batch = x.shape[0]
@@ -124,8 +162,35 @@ def pipeline_apply(
         raise ValueError(
             f"stage_params leading dim(s) {leading} != {n_stages} stages"
         )
+    unsupported = [
+        a
+        for a in mesh.axis_names
+        if a not in (axis, DATA_AXIS) and mesh.shape[a] > 1
+    ]
+    if unsupported:
+        raise ValueError(
+            f"pipeline_apply supports only {axis!r}×{DATA_AXIS!r} meshes; "
+            f"got extra nontrivial axes {unsupported}"
+        )
+    data = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+    if data:
+        micro = batch // n_micro
+        if micro % mesh.shape[data]:
+            raise ValueError(
+                f"microbatch {micro} not divisible by the {data!r} axis "
+                f"({mesh.shape[data]} ways)"
+            )
+    # Microbatch dim replicated over stages, example dim sharded over data.
+    batch_spec = P(None, data) if data else P()
 
     xs = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+    aux_ms = (
+        jax.tree.map(
+            lambda a: a.reshape(n_micro, batch // n_micro, *a.shape[1:]), aux
+        )
+        if aux is not None
+        else None
+    )
     fn = jax.shard_map(
         functools.partial(
             _pipeline_shard_fn,
@@ -135,8 +200,13 @@ def pipeline_apply(
             mesh_axes=(axis,),
         ),
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(
+            P(axis),
+            batch_spec,
+            jax.tree.map(lambda _: batch_spec, aux_ms),
+            jax.tree.map(lambda _: P(), aux_replicated),
+        ),
+        out_specs=batch_spec,
     )
-    out = fn(stage_params, xs)
+    out = fn(stage_params, xs, aux_ms, aux_replicated)
     return out.reshape(batch, *x.shape[1:])

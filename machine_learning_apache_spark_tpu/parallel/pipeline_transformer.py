@@ -1,0 +1,188 @@
+"""Pipelined Transformer forward — the zoo model over a ``"pipeline"`` mesh axis.
+
+The reference has no PP (SURVEY.md §2.3); this wires the GPipe-style
+schedule in ``pipeline_parallel`` into the flagship encoder-decoder
+Transformer (C23, ``transformer.py:255-284``) the standard way: embeddings
+and the LM head stay outside the pipelined region (they are not part of the
+homogeneous layer stack), the encoder stack and the decoder stack are each
+pipelined over the mesh's ``"pipeline"`` axis with ``num_layers /
+n_stages`` layers per stage, and per-microbatch attention masks plus the
+encoder memory ride the ``aux`` channel so each stage sees the constants of
+the microbatch it is currently processing.
+
+Composes with data parallelism (a ``data`` axis on the same mesh shards the
+microbatch dim); TP/SP/EP inside a pipeline stage are out of scope and
+rejected by ``pipeline_apply``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from machine_learning_apache_spark_tpu.models.transformer import (
+    DecoderLayer,
+    EncoderLayer,
+    SentenceEmbedding,
+    Transformer,
+)
+from machine_learning_apache_spark_tpu.parallel.mesh import PIPELINE_AXIS
+from machine_learning_apache_spark_tpu.parallel.pipeline_parallel import (
+    pipeline_apply,
+)
+
+
+def _stack_layer_params(tree: dict, num_layers: int, n_stages: int):
+    """``layer_0..layer_{L-1}`` subtrees → one pytree with leaves
+    ``[n_stages, layers_per_stage, ...]`` (stage s, slot j = layer
+    ``s * layers_per_stage + j``)."""
+    layers = [tree[f"layer_{i}"] for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
+    lps = num_layers // n_stages
+    return jax.tree.map(
+        lambda p: p.reshape(n_stages, lps, *p.shape[1:]), stacked
+    )
+
+
+def pipeline_transformer_logits(
+    model: Transformer,
+    params,
+    src_tokens: jnp.ndarray,
+    trg_in: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    n_micro: int | None = None,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+) -> jnp.ndarray:
+    """Teacher-forced logits for ``(src, trg_in)`` with both layer stacks
+    pipelined — numerically identical to
+    ``model.apply({"params": params}, src, trg_in)`` (parity pinned by
+    ``tests/test_pipeline_parallel.py``), scheduled as two GPipe rings.
+
+    ``trg_in`` is the decoder input (the caller's ``trg[:, :-1]``). With
+    ``rng`` and ``deterministic=False``, dropout runs with keys folded per
+    (microbatch, stage, layer) — a valid dropout pattern, though not
+    bit-identical to the sequential path's single-key pattern.
+    """
+    cfg = model.cfg
+    if cfg.moe_experts:
+        raise ValueError("pipeline parallelism does not support MoE layers")
+    n_stages = mesh.shape[PIPELINE_AXIS]
+    if cfg.num_layers % n_stages:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by "
+            f"{n_stages} pipeline stages"
+        )
+    lps = cfg.num_layers // n_stages
+    n_micro = n_micro or n_stages
+    det = bool(deterministic or rng is None)
+    params = nn.unbox(params)
+    pad = cfg.pad_id
+    src_valid = src_tokens != pad
+    trg_valid = trg_in != pad
+
+    embed_rngs = lambda tag: (
+        None if det else {"dropout": jax.random.fold_in(rng, tag)}
+    )
+    x = SentenceEmbedding(cfg.src_vocab_size, cfg).apply(
+        {"params": params["encoder"]["embed"]},
+        src_tokens,
+        deterministic=det,
+        rngs=embed_rngs(0),
+    )
+    y = SentenceEmbedding(cfg.trg_vocab_size, cfg).apply(
+        {"params": params["decoder"]["embed"]},
+        trg_in,
+        deterministic=det,
+        rngs=embed_rngs(1),
+    )
+
+    # One key per (microbatch, ring): ride the replicated aux channel as raw
+    # key data (stages fold in their stage/layer/data-shard index).
+    def micro_keys(tag):
+        if det:
+            return None
+        return jax.random.key_data(
+            jax.random.split(jax.random.fold_in(rng, tag), n_micro)
+        )
+
+    from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+
+    data_ways = (
+        mesh.shape[DATA_AXIS] if DATA_AXIS in mesh.axis_names else 1
+    )
+
+    def layer_rngs(key_data, stage_id, j):
+        if det:
+            return None
+        key = jax.random.wrap_key_data(key_data)
+        key = jax.random.fold_in(key, stage_id * lps + j)
+        if data_ways > 1:
+            # Decorrelate dropout masks across data shards — the replicated
+            # aux key is identical on every shard, but the examples differ.
+            key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+        return {"dropout": key}
+
+    def maybe_remat(body):
+        # Honor cfg.remat inside the pipelined region: recompute each
+        # layer's activations in the backward instead of saving every
+        # tick's intermediates — the same FLOPs-for-HBM trade the
+        # sequential stacks make (models/transformer.py nn.remat).
+        return jax.checkpoint(body) if cfg.remat else body
+
+    def enc_stage(stage_params, h, aux_m, rep_m, stage_id, t):
+        (valid,) = aux_m
+        for j in range(lps):
+            lp = jax.tree.map(lambda p: p[j], stage_params)
+            body = maybe_remat(
+                lambda lp, h, j=j: EncoderLayer(cfg).apply(
+                    {"params": lp}, h, None, valid, det, None,
+                    rngs=layer_rngs(rep_m, stage_id, j),
+                )
+            )
+            h = body(lp, h)
+        return h
+
+    memory = pipeline_apply(
+        enc_stage,
+        _stack_layer_params(params["encoder"], cfg.num_layers, n_stages),
+        x,
+        mesh,
+        n_micro=n_micro,
+        aux=(src_valid,),
+        aux_replicated=micro_keys(2),
+    )
+
+    def dec_stage(stage_params, h, aux_m, rep_m, stage_id, t):
+        mem, tv, sv = aux_m
+        for j in range(lps):
+            lp = jax.tree.map(lambda p: p[j], stage_params)
+            body = maybe_remat(
+                lambda lp, h, j=j: DecoderLayer(cfg).apply(
+                    {"params": lp}, h, mem, None, None, tv, sv,
+                    True, False, det, None,  # self_causal, decode, deterministic
+                    rngs=layer_rngs(rep_m, stage_id, j),
+                )
+            )
+            h = body(lp, h)
+        return h
+
+    y = pipeline_apply(
+        dec_stage,
+        _stack_layer_params(params["decoder"], cfg.num_layers, n_stages),
+        y,
+        mesh,
+        n_micro=n_micro,
+        aux=(memory, trg_valid, src_valid),
+        aux_replicated=micro_keys(3),
+    )
+
+    logits = nn.Dense(
+        cfg.trg_vocab_size + cfg.logit_pad, dtype=cfg.dtype, name="lm_head"
+    ).apply({"params": params["lm_head"]}, y)
+    if cfg.logit_pad:
+        logits = logits[..., : cfg.trg_vocab_size]
+    return logits

@@ -35,6 +35,7 @@ def resolve_mesh(
     model_parallel: int = 1,
     sequence_parallel: int = 1,
     expert_parallel: int = 1,
+    pipeline_parallel: int = 1,
 ):
     """Device mesh for a recipe, or None when a mesh buys nothing.
 
@@ -42,13 +43,15 @@ def resolve_mesh(
     reference's DDP world). ``model_parallel=N`` carves an inner ``"model"``
     axis (tensor parallelism over the zoo's logical annotations);
     ``sequence_parallel=N`` carves a ``"seq"`` axis for ring attention;
-    ``expert_parallel=N`` carves an ``"expert"`` axis for MoE expert weights.
-    The remaining devices form the ``"data"`` axis.
+    ``expert_parallel=N`` carves an ``"expert"`` axis for MoE expert weights;
+    ``pipeline_parallel=N`` carves a ``"pipeline"`` axis for GPipe-style
+    stage parallelism. The remaining devices form the ``"data"`` axis.
     """
     extra = {
         "model_parallel": model_parallel,
         "sequence_parallel": sequence_parallel,
         "expert_parallel": expert_parallel,
+        "pipeline_parallel": pipeline_parallel,
     }
     any_extra = any(v > 1 for v in extra.values())
     if jax.process_count() > 1 and not use_mesh:
@@ -76,11 +79,14 @@ def resolve_mesh(
         from machine_learning_apache_spark_tpu.parallel.mesh import (
             EXPERT_AXIS,
             MODEL_AXIS,
+            PIPELINE_AXIS,
             SEQ_AXIS,
             make_mesh,
         )
 
         axes = {DATA_AXIS: -1}
+        if pipeline_parallel > 1:
+            axes[PIPELINE_AXIS] = pipeline_parallel
         if expert_parallel > 1:
             axes[EXPERT_AXIS] = expert_parallel
         if model_parallel > 1:

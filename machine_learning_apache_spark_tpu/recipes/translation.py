@@ -67,6 +67,13 @@ class TranslationRecipe:
     # through to the dense/flash path).
     model_parallel: int = 1
     sequence_parallel: int = 1
+    # GPipe-style pipeline parallelism over a mesh "pipeline" axis: the
+    # encoder and decoder layer stacks each run as a microbatched ppermute
+    # ring (parallel.pipeline_transformer), embeddings/LM-head outside the
+    # pipelined region. Requires num_layers % pipeline_parallel == 0; the
+    # training forward is pipelined, eval uses the (numerically identical)
+    # sequential path so ragged tails stay supported. Composes with DP only.
+    pipeline_parallel: int = 1
     # Mixture-of-experts FFN (models.moe): moe_experts switch-routed experts
     # per FFN site; expert_parallel shards their weights over a mesh
     # "expert" axis. The Switch aux loss joins the task loss automatically.
@@ -134,6 +141,29 @@ def make_translation_loss(model, pad_id: int, *, train: bool = True):
         logits = model.apply({"params": params}, src, trg[:, :-1], **kwargs)
         loss = masked_token_cross_entropy(logits, trg[:, 1:], pad_id)
         return loss, {}
+
+    return loss_fn
+
+
+def make_pipeline_translation_loss(
+    model, pad_id: int, mesh, *, n_micro: int | None = None, train: bool = True
+):
+    """The training loss with the forward scheduled as two GPipe rings over
+    the mesh's ``"pipeline"`` axis (``parallel.pipeline_transformer``) —
+    same pad-masked CE semantics as ``make_translation_loss``."""
+    from machine_learning_apache_spark_tpu.parallel.pipeline_transformer import (
+        pipeline_transformer_logits,
+    )
+
+    def loss_fn(params, batch, rng):
+        src, trg = batch
+        logits = pipeline_transformer_logits(
+            model, params, src, trg[:, :-1], mesh,
+            n_micro=n_micro,
+            rng=rng if train else None,
+            deterministic=not train,
+        )
+        return masked_token_cross_entropy(logits, trg[:, 1:], pad_id), {}
 
     return loss_fn
 
@@ -213,11 +243,37 @@ def train_translator(
             "bucket_by_length is incompatible with sequence_parallel: the "
             "ring needs one fixed seq-axis-divisible length"
         )
+    if r.pipeline_parallel > 1:
+        # The pipeline schedule supports dp×pp meshes only (TP/SP inside a
+        # stage and MoE capacity routing are out of scope for the ring).
+        incompatible = {
+            "model_parallel": r.model_parallel,
+            "sequence_parallel": r.sequence_parallel,
+            "expert_parallel": r.expert_parallel,
+        }
+        bad = {k: v for k, v in incompatible.items() if v > 1}
+        if bad or r.moe_experts:
+            raise ValueError(
+                f"pipeline_parallel={r.pipeline_parallel} composes with "
+                f"data parallelism only; incompatible settings: "
+                f"{bad or {'moe_experts': r.moe_experts}}"
+            )
+        if r.bucket_by_length:
+            raise ValueError(
+                "pipeline_parallel is incompatible with bucket_by_length "
+                "(the microbatch split needs one fixed batch shape)"
+            )
+        if r.num_layers % r.pipeline_parallel:
+            raise ValueError(
+                f"num_layers={r.num_layers} must divide into "
+                f"{r.pipeline_parallel} pipeline stages"
+            )
     mesh = resolve_mesh(
         r.use_mesh,
         model_parallel=r.model_parallel,
         sequence_parallel=r.sequence_parallel,
         expert_parallel=r.expert_parallel,
+        pipeline_parallel=r.pipeline_parallel,
     )
     # Under bucketing the fixed-width train loader is never used: build only
     # the eval loader (full-coverage contract keeps the fixed width).
@@ -319,10 +375,15 @@ def train_translator(
                     accumulate_steps=r.grad_accum,
                 )
             )
+        train_loss = (
+            make_pipeline_translation_loss(model, cfg.pad_id, mesh)
+            if r.pipeline_parallel > 1
+            else make_translation_loss(model, cfg.pad_id)
+        )
         with sp_ctx:
             result = fit(
                 state,
-                make_translation_loss(model, cfg.pad_id),
+                train_loss,
                 train_loader,
                 epochs=r.epochs,
                 rng=jax.random.key(r.seed),

@@ -113,3 +113,22 @@ def dp_train_step_parity():
         "fingerprint": params_fingerprint(state.params),
         "divergence": divergence,
     }
+
+
+def multihost_probe():
+    """Multi-host control-plane probe: prints a parseable line with this
+    rank's view of the world plus a cross-process collective sum — consumed
+    by the commands_for_hosts end-to-end test, which drives the LITERAL
+    launch commands an external scheduler (spark-submit's role,
+    ``distributed_cnn.py:227-231``) would execute."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    rank = jax.process_index()
+    world = jax.process_count()
+    gathered = multihost_utils.process_allgather(jnp.asarray([rank + 1.0]))
+    print(
+        f"MULTIHOST_RESULT rank={rank} world={world} sum={float(gathered.sum())}",
+        flush=True,
+    )

@@ -217,3 +217,98 @@ class TestRegisteredCustomTokenizer:
             register_tokenizer("word_punct", lambda s: s.split())
         with _pytest.raises(TypeError, match="callable"):
             register_tokenizer("not_fn", 42)
+
+    def test_custom_tokenizer_fresh_process_round_trip(self, trained, tmp_path):
+        """The full spacy-seam contract (``pytorch_machine_translator.py:20-21``):
+        a custom tokenizer registered under its own name → ``save`` → a FRESH
+        python process re-registers the name, ``load``s, and produces
+        identical translations. Same-process reload (above) can hide registry
+        state leaking through module globals; a subprocess cannot."""
+        import json as _json
+        import os
+        import subprocess
+        import sys
+
+        from machine_learning_apache_spark_tpu.data.text import (
+            TextPipeline,
+            register_tokenizer,
+        )
+
+        def upper_split(s):
+            return s.upper().split()
+
+        register_tokenizer("upper_split_fresh", upper_split)
+        try:
+            t, _ = trained
+            custom = Translator(
+                t.model, t.params,
+                TextPipeline(
+                    t.src_pipe.vocab, "upper_split_fresh", max_seq_len=9,
+                    fixed_len=10,
+                ),
+                t.trg_pipe,
+            )
+            model_dir = str(tmp_path / "fresh")
+            custom.save(model_dir)
+            srcs = ["alpha beta gamma", "delta epsilon"]
+            before = custom(srcs)
+
+            repo_root = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": repo_root
+                + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+            }
+            # The hosting image may pre-import jax from sitecustomize, so the
+            # children also force the platform via the config API.
+            preamble = (
+                "import jax\n"
+                "jax.config.update('jax_platforms', 'cpu')\n"
+            )
+            child = preamble + f"""
+import json
+from machine_learning_apache_spark_tpu.data.text import register_tokenizer
+from machine_learning_apache_spark_tpu.inference import Translator
+
+def upper_split(s):
+    return s.upper().split()
+
+register_tokenizer("upper_split_fresh", upper_split)
+loaded = Translator.load({model_dir!r})
+assert loaded.src_pipe.tokenizer is upper_split
+print("RESULT:" + json.dumps(loaded({srcs!r})))
+"""
+            proc = subprocess.run(
+                [sys.executable, "-c", child],
+                capture_output=True, text=True, timeout=600,
+                cwd=str(tmp_path), env=env,
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            line = [
+                l for l in proc.stdout.splitlines() if l.startswith("RESULT:")
+            ][0]
+            assert _json.loads(line[len("RESULT:"):]) == before
+
+            # Without the re-registration the load must fail loudly (the
+            # recorded name cannot resolve), not silently mistokenize.
+            bad = subprocess.run(
+                [
+                    sys.executable, "-c",
+                    preamble
+                    + "from machine_learning_apache_spark_tpu.inference "
+                    "import Translator\n"
+                    f"Translator.load({model_dir!r})",
+                ],
+                capture_output=True, text=True, timeout=600,
+                cwd=str(tmp_path), env=env,
+            )
+            assert bad.returncode != 0
+            assert "upper_split_fresh" in bad.stderr
+        finally:
+            from machine_learning_apache_spark_tpu.data import text
+
+            text._TOKENIZERS.pop("upper_split_fresh", None)

@@ -174,3 +174,57 @@ class TestCommandsForHosts:
     def test_cluster_run_raises(self):
         with pytest.raises(RuntimeError, match="commands_for_hosts"):
             Distributor(local_mode=False).run("launcher_workers:echo_rank")
+
+    @pytest.mark.slow
+    def test_commands_execute_end_to_end(self):
+        """The multi-host control plane, end to end: execute the LITERAL
+        command strings from ``commands_for_hosts`` (2 "hosts" on loopback —
+        the role spark-submit plays for ``distributed_cnn.py:227-231``),
+        and assert both ranks rendezvous over the coordinator and agree on
+        a cross-process collective sum. The scheduler's own contribution is
+        environment only (PYTHONPATH + platform), never edited commands."""
+        import os
+        import shlex
+        import subprocess
+        import sys
+
+        from machine_learning_apache_spark_tpu.launcher.distributor import (
+            _free_port,
+        )
+
+        port = _free_port()
+        cmds = Distributor(local_mode=False).commands_for_hosts(
+            "launcher_workers:multihost_probe",
+            ["127.0.0.1", "127.0.0.1"],
+            coordinator_port=port,
+        )
+        env = {
+            **os.environ,
+            # Both forms, like Distributor._run_gang: the env var for vanilla
+            # images, MLSPARK_PLATFORM for the runner's config-API override.
+            "JAX_PLATFORMS": "cpu",
+            "MLSPARK_PLATFORM": "cpu",
+            "PYTHONPATH": os.pathsep.join(p for p in sys.path if p),
+        }
+        procs = [
+            subprocess.Popen(
+                shlex.split(c),
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+            for c in cmds
+        ]
+        outs = []
+        try:
+            for p in procs:
+                outs.append(p.communicate(timeout=300))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for rank, (p, (out, err)) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{err[-2000:]}"
+            assert f"MULTIHOST_RESULT rank={rank} world=2 sum=3.0" in out, out
+
