@@ -247,9 +247,9 @@ def bench_transformer(
         make_optimizer,
     )
 
-    batch_per_chip = batch_per_chip or BATCH_PER_CHIP
-    trials = trials or TRIALS
-    steps = steps or STEPS
+    batch_per_chip = BATCH_PER_CHIP if batch_per_chip is None else batch_per_chip
+    trials = TRIALS if trials is None else trials
+    steps = STEPS if steps is None else steps
     warmup = WARMUP if warmup is None else warmup
     n_chips = jax.device_count()
     device = jax.devices()[0]

@@ -13,8 +13,9 @@ and discards, ``pytorch_machine_translator.py:107-209``):
 - a text-in/text-out Translator, saved as a deployable directory
 
 On a multi-chip mesh the same run data-parallels automatically; add
-``model_parallel=``/``sequence_parallel=``/``expert_parallel=`` for
-TP/SP/EP. Usage: python examples/advanced_translator.py [multi30k_root]
+``model_parallel=``/``sequence_parallel=``/``expert_parallel=``/
+``pipeline_parallel=`` for TP/SP/EP/PP.
+Usage: python examples/advanced_translator.py [multi30k_root]
 """
 
 import os

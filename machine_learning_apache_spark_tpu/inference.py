@@ -144,6 +144,7 @@ class Classifier:
         *,
         pipeline: TextPipeline | None = None,
         last_timestep: bool = False,
+        head_pad_id: int | None = None,
         batch_size: int = 256,
     ):
         import flax.linen as nn
@@ -152,6 +153,10 @@ class Classifier:
         self.params = nn.unbox(params)
         self.pipeline = pipeline
         self.last_timestep = last_timestep
+        # With head_pad_id set, last_timestep reads each row's last NON-PAD
+        # position (the classify_from="last_valid" training semantics) —
+        # prediction must select the same position the loss trained.
+        self.head_pad_id = head_pad_id
         self.batch_size = batch_size
 
     def _logits(self, inputs) -> jnp.ndarray:
@@ -163,11 +168,17 @@ class Classifier:
         x = jnp.asarray(inputs)
         outs = []
         for i in range(0, len(x), self.batch_size):
-            logits = self.model.apply(
-                {"params": self.params}, x[i : i + self.batch_size]
-            )
+            chunk = x[i : i + self.batch_size]
+            logits = self.model.apply({"params": self.params}, chunk)
             if self.last_timestep:
-                logits = logits[:, -1, :]
+                if self.head_pad_id is not None:
+                    from machine_learning_apache_spark_tpu.train.loop import (
+                        select_last_valid,
+                    )
+
+                    logits = select_last_valid(logits, chunk, self.head_pad_id)
+                else:
+                    logits = logits[:, -1, :]
             outs.append(logits.astype(jnp.float32))
         return jnp.concatenate(outs, axis=0)
 
@@ -186,6 +197,7 @@ class Classifier:
         meta = {
             **_model_spec(self.model),
             "last_timestep": self.last_timestep,
+            "head_pad_id": self.head_pad_id,
         }
         if self.pipeline is not None:
             _check_registered_tokenizer(self.pipeline)
@@ -223,6 +235,7 @@ class Classifier:
             load_params(os.path.join(directory, "params")),
             pipeline=pipeline,
             last_timestep=meta["last_timestep"],
+            head_pad_id=meta.get("head_pad_id"),
         )
 
 

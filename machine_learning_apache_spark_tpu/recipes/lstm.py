@@ -21,7 +21,10 @@ from machine_learning_apache_spark_tpu.data.datasets import (
     load_ag_news,
     synthetic_text_classification,
 )
-from machine_learning_apache_spark_tpu.data.text import classification_pipeline
+from machine_learning_apache_spark_tpu.data.text import (
+    PAD_ID,
+    classification_pipeline,
+)
 from machine_learning_apache_spark_tpu.models import LSTMClassifier
 from machine_learning_apache_spark_tpu.train.loop import (
     classification_loss,
@@ -71,6 +74,13 @@ class LSTMRecipe:
     # Structured observability: append per-epoch + end-of-run JSON lines
     # (train.metrics.MetricsLogger) alongside the print vocabulary.
     metrics_path: str | None = None
+    # Which position feeds the classifier head: "last" is the reference's
+    # read of the FINAL column (``pytorch_lstm.py:160`` — on end-padded
+    # batches that is the state after up to fixed_len − len(row) pad steps);
+    # "last_valid" reads each row's last non-pad position — the
+    # correct-semantics variant, markedly faster to learn on short-text
+    # corpora (see PARITY.md fixture runs).
+    classify_from: str = "last"
 
 
 def train_lstm(
@@ -150,13 +160,20 @@ def train_lstm(
     )
 
     # Loss on the final timestep's logits — pred[:, -1, :]
-    # (``pytorch_lstm.py:160``).
+    # (``pytorch_lstm.py:160``) — or each row's last non-pad position under
+    # classify_from="last_valid".
+    if r.classify_from not in ("last", "last_valid"):
+        raise ValueError(
+            f"classify_from must be 'last' or 'last_valid', got "
+            f"{r.classify_from!r}"
+        )
+    head_pad = PAD_ID if r.classify_from == "last_valid" else None
     with checkpointing(
         r.checkpoint_dir, state, resume=r.resume
     ) as (ckpt, state, resumed):
         result = fit(
             state,
-            classification_loss(model.apply, last_timestep=True),
+            classification_loss(model.apply, last_timestep=True, pad_id=head_pad),
             train_loader,
             epochs=r.epochs,
             rng=jax.random.key(r.seed),
@@ -168,7 +185,9 @@ def train_lstm(
         )
     metrics = evaluate(
         result.state,
-        classification_loss(model.apply, last_timestep=True, train=False),
+        classification_loss(
+            model.apply, last_timestep=True, train=False, pad_id=head_pad
+        ),
         test_loader,
         mesh=mesh,
     )
@@ -185,6 +204,7 @@ def train_lstm(
         from machine_learning_apache_spark_tpu.inference import Classifier
 
         out["classifier"] = Classifier(
-            model, result.state.params, pipeline=pipe, last_timestep=True
+            model, result.state.params, pipeline=pipe, last_timestep=True,
+            head_pad_id=head_pad,
         )
     return out

@@ -74,6 +74,11 @@ class TranslationRecipe:
     # training forward is pipelined, eval uses the (numerically identical)
     # sequential path so ragged tails stay supported. Composes with DP only.
     pipeline_parallel: int = 1
+    # Microbatches per pipelined batch (None → one per stage). More
+    # microbatches shrink the pipeline bubble (S−1 idle ticks amortized
+    # over M) at the cost of smaller per-tick matmuls; the global batch
+    # must divide by it, and each microbatch by the data axis.
+    pipeline_microbatches: int | None = None
     # Mixture-of-experts FFN (models.moe): moe_experts switch-routed experts
     # per FFN site; expert_parallel shards their weights over a mesh
     # "expert" axis. The Switch aux loss joins the task loss automatically.
@@ -376,7 +381,9 @@ def train_translator(
                 )
             )
         train_loss = (
-            make_pipeline_translation_loss(model, cfg.pad_id, mesh)
+            make_pipeline_translation_loss(
+                model, cfg.pad_id, mesh, n_micro=r.pipeline_microbatches
+            )
             if r.pipeline_parallel > 1
             else make_translation_loss(model, cfg.pad_id)
         )

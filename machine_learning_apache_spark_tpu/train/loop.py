@@ -304,15 +304,34 @@ def evaluate(
     return out
 
 
+def select_last_valid(
+    logits: jnp.ndarray, tokens: jnp.ndarray, pad_id: int
+) -> jnp.ndarray:
+    """``[B, T, C]`` logits → ``[B, C]`` at each row's last non-pad
+    position (all-pad rows fall back to position 0). Training loss and
+    serving (``inference.Classifier``) MUST select through this one helper
+    — scoring a different timestep than the loss trained silently degrades
+    every deployed last-valid classifier."""
+    idx = jnp.maximum((tokens != pad_id).sum(axis=-1) - 1, 0)
+    return jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0, :]
+
+
 def classification_loss(
-    apply_fn, *, last_timestep: bool = False, train: bool = True
+    apply_fn, *, last_timestep: bool = False, train: bool = True,
+    pad_id: int | None = None,
 ) -> LossFn:
     """Standard CE classification loss over ``(features, labels)`` batches.
 
     ``last_timestep=True`` selects ``logits[:, -1, :]`` — the LSTM recipe's
-    last-position head (``pytorch_lstm.py:160``). ``train=True`` runs dropout
-    (``model.train()``); pass ``train=False`` for the eval pass
-    (``model.eval()`` + ``no_grad``, ``pytorch_cnn.py:154-176``).
+    last-position head (``pytorch_lstm.py:160``). With ``pad_id`` set, the
+    selection becomes each row's last NON-PAD position instead of the fixed
+    final column — the correct-semantics variant of the reference's
+    last-position read, which on end-padded batches scores the hidden state
+    after up to ``fixed_len − len(row)`` pad steps (state the recurrence
+    must carry through constant inputs; a learning-speed tax the reference
+    pays silently). ``train=True`` runs dropout (``model.train()``); pass
+    ``train=False`` for the eval pass (``model.eval()`` + ``no_grad``,
+    ``pytorch_cnn.py:154-176``).
     """
     from machine_learning_apache_spark_tpu.train.losses import cross_entropy
 
@@ -325,7 +344,10 @@ def classification_loss(
             rngs={"dropout": rng} if train else None,
         )
         if last_timestep:
-            logits = logits[:, -1, :]
+            if pad_id is not None:
+                logits = select_last_valid(logits, features, pad_id)
+            else:
+                logits = logits[:, -1, :]
         loss = cross_entropy(logits, labels)
         return loss, {"accuracy": logits_accuracy(logits, labels)}
 

@@ -53,6 +53,19 @@ class TestLSTMRecipe:
         # should beat 4-class chance
         assert out["accuracy"] > 30.0  # percent
 
+    def test_classify_from_last_valid(self):
+        """The correct-semantics head (each row's last non-pad position)
+        learns the same corpus markedly better than the reference's
+        final-column read, which scores state carried through pad steps."""
+        out = train_lstm(
+            epochs=2, synthetic_n=512, batch_size=16, max_seq_len=24,
+            classify_from="last_valid",
+        )
+        assert out["history"][-1]["loss"] < out["history"][0]["loss"]
+        assert out["accuracy"] > 40.0  # percent; "last" clears 30 here
+        with pytest.raises(ValueError, match="classify_from"):
+            train_lstm(epochs=1, synthetic_n=64, classify_from="middle")
+
     def test_bucketed_training(self):
         """bucket_by_length reachable from the recipe surface: training
         batches pad to bucket boundaries (scan FLOPs scale with the bucket)
@@ -184,6 +197,7 @@ class TestParallelismFlags:
             num_layers=4,
             log_every=0,
             pipeline_parallel=4,
+            pipeline_microbatches=8,  # bubble-control knob: M > stages
         )
         assert out["history"][-1]["loss"] < out["history"][0]["loss"]
         assert "test_loss" in out

@@ -48,6 +48,32 @@ class TestLosses:
         loss2 = masked_token_cross_entropy(logits2, labels)
         np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
 
+    def test_classification_loss_last_valid_position(self, rng):
+        """``pad_id`` switches the last-timestep head from the final column
+        to each row's last non-pad position — the correct-semantics variant
+        of ``pred[:, -1, :]`` (``pytorch_lstm.py:160``) for end-padded rows."""
+        seqs = jnp.asarray([[5, 3, 7, 0, 0], [2, 0, 0, 0, 0], [4, 4, 4, 4, 4]])
+        labels = jnp.asarray([1, 0, 2])
+        logits = jnp.asarray(
+            rng.standard_normal((3, 5, 3)), dtype=jnp.float32
+        )
+
+        apply_fn = lambda vars_, x, **kw: logits  # model stub
+        loss_fn = classification_loss(apply_fn, last_timestep=True, pad_id=0)
+        loss, aux = loss_fn({}, (seqs, labels), jax.random.key(0))
+        # rows' last valid positions: 2, 0, 4
+        picked = logits[jnp.arange(3), jnp.asarray([2, 0, 4])]
+        np.testing.assert_allclose(
+            float(loss), float(cross_entropy(picked, labels)), rtol=1e-6
+        )
+        # default semantics still reads the final column
+        loss_fn_ref = classification_loss(apply_fn, last_timestep=True)
+        loss_ref, _ = loss_fn_ref({}, (seqs, labels), jax.random.key(0))
+        np.testing.assert_allclose(
+            float(loss_ref), float(cross_entropy(logits[:, -1, :], labels)),
+            rtol=1e-6,
+        )
+
 
 def _synthetic_classification(rng, n=120, features=4, classes=3):
     """4-feature/3-class data shaped like the MLlib libsvm sample
