@@ -327,9 +327,89 @@ class TextPipeline:
             steps.append(PadToLength(fixed_len, PAD_ID))
         steps.append(ToArray(PAD_ID))
         self.transform = Sequential(*steps)
+        import threading
+
+        self._native_vocab: tuple[int, int] | None = None  # (pid, handle)
+        self._native_vocab_lock = threading.Lock()
+
+    def _encode_native(self, texts: Sequence[str]) -> np.ndarray | None:
+        """C++ fast path (native.text_native): one pass over the batch for
+        the built-in tokenizers on ASCII text with a fixed output width.
+        Returns None whenever any gate fails — the Python chain is always
+        the semantic reference (parity pinned by tests/test_native.py)."""
+        import os as _os
+
+        if _os.environ.get("MLSPARK_NO_NATIVE_TEXT"):
+            return None
+        mode = None
+        name = self.spec["tokenizer"]
+        if name in ("basic_english", "word_punct"):
+            # Only when the name still resolves to the built-in — a
+            # register_tokenizer(overwrite=True) shadow must win.
+            if self.tokenizer is _TOKENIZERS.get(name):
+                mode = {"basic_english": 0, "word_punct": 1}[name]
+        if mode is None or self.spec["fixed_len"] is None or not texts:
+            return None
+        if not all(isinstance(t, str) and t.isascii() for t in texts):
+            return None
+        try:
+            from machine_learning_apache_spark_tpu.native import text_native
+        except ImportError:
+            return None
+        try:
+            pid = _os.getpid()
+            with self._native_vocab_lock:
+                if self._native_vocab is None or self._native_vocab[0] != pid:
+                    itos = self.vocab.itos
+                    if any("\n" in t for t in itos):
+                        return None  # '\n' is the handle blob's separator
+                    # Handles are process-local: rebuild after fork (each
+                    # process has its own registry copy). Freed at pipeline
+                    # GC via weakref.finalize so long-lived processes that
+                    # build many pipelines don't accumulate C++ vocab maps.
+                    import weakref
+
+                    handle = text_native.vocab_handle(itos)
+                    weakref.finalize(self, text_native.vocab_free, handle)
+                    self._native_vocab = (pid, handle)
+            return text_native.encode(
+                self._native_vocab[1],
+                list(texts),
+                mode=mode,
+                max_seq_len=self.spec["max_seq_len"],
+                fixed_len=self.spec["fixed_len"],
+                add_sos=self.spec["add_sos"],
+                add_eos=self.spec["add_eos"],
+                sos_id=SOS_ID,
+                eos_id=EOS_ID,
+                pad_id=PAD_ID,
+                default_index=self.vocab.default_index,
+            )
+        except (ImportError, RuntimeError, OSError):
+            return None  # never fail the pipeline over the fast path
 
     def __call__(self, texts: Sequence[str]) -> np.ndarray:
+        # Materialize once: the native gate iterates texts (isascii scan)
+        # and a one-shot generator must not be exhausted before encoding.
+        texts = list(texts)
+        arr = self._encode_native(texts)
+        if arr is not None:
+            return arr
         return self.transform([self.tokenizer(t) for t in texts])
+
+    def __getstate__(self):
+        # Native handle and its lock are process-local, unpicklable state.
+        d = self.__dict__.copy()
+        d["_native_vocab"] = None
+        d.pop("_native_vocab_lock", None)
+        return d
+
+    def __setstate__(self, d):
+        import threading
+
+        self.__dict__.update(d)
+        self._native_vocab = None
+        self._native_vocab_lock = threading.Lock()
 
     def ragged(self, texts: Sequence[str]) -> list[list[int]]:
         """Token-id lists *before* rectangularization — the input to length

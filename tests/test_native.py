@@ -148,3 +148,109 @@ class TestGatherRows:
         batches = list(DataLoader(ds, 16, shuffle=True, seed=3))
         assert len(batches) == 4
         assert batches[0][0].shape == (16, 5)
+
+
+class TestTextEncode:
+    """C++ batch text encoding (text_encode.cpp) — exact parity with the
+    Python TextPipeline chain on every gate-passing input, and correct
+    fallback on every gate-failing one."""
+
+    TORTURE = [
+        "Hello, World! This is a test.",
+        "quotes \"glue\" neighbors together",
+        "don't; split: this (and) that?",
+        "html <br /> breaks <br />here",
+        "  collapse   whitespace\tand\nnewlines  ",
+        "punct-only !?.,()",
+        "",
+        "under_scores and digits 123 mix_99",
+        "trailing apostrophe '",
+        "a" * 300,  # single token longer than max_seq_len
+        " ".join(str(i) for i in range(200)),  # truncation boundary
+        "a\x1cb control\x1dwhitespace\x1e splits \x1f here",  # \s ⊃ \x1c-\x1f
+        'x<br" />y quote inside the tag',  # quote deletion precedes <br /> match
+    ]
+
+    def _pipes(self, tokenizer, fixed_len=24, max_seq_len=20, **kw):
+        from machine_learning_apache_spark_tpu.data.text import TextPipeline
+
+        return TextPipeline.fit(
+            self.TORTURE, tokenizer, max_seq_len=max_seq_len,
+            fixed_len=fixed_len, **kw,
+        )
+
+    @pytest.mark.parametrize("tokenizer", ["basic_english", "word_punct"])
+    def test_parity_with_python_chain(self, tokenizer, monkeypatch):
+        from machine_learning_apache_spark_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        pipe = self._pipes(tokenizer)
+        got = pipe(self.TORTURE)
+        # Force the Python path for the reference output.
+        monkeypatch.setenv("MLSPARK_NO_NATIVE_TEXT", "1")
+        want = pipe(self.TORTURE)
+        np.testing.assert_array_equal(got, want)
+        assert got.dtype == want.dtype == np.int32
+
+    def test_oov_uses_default_index(self, monkeypatch):
+        from machine_learning_apache_spark_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        pipe = self._pipes("word_punct")
+        texts = ["zzz_never_seen hello unknown_word_here"]
+        got = pipe(texts)
+        monkeypatch.setenv("MLSPARK_NO_NATIVE_TEXT", "1")
+        np.testing.assert_array_equal(got, pipe(texts))
+
+    def test_generator_input_encodes_fully(self):
+        """One-shot iterables must not be exhausted by the native gate's
+        ascii scan — the batch still encodes completely."""
+        pipe = self._pipes("word_punct")
+        out = pipe(t for t in ["hello world", "second row"])
+        assert out.shape == (2, 24)
+        assert (out != 0).any(axis=1).all()  # both rows carry real tokens
+
+    def test_non_ascii_falls_back_and_agrees(self):
+        """Non-ASCII batches route to Python; results still come back (the
+        gate is per-batch, not an error)."""
+        pipe = self._pipes("word_punct")
+        out = pipe(["ein mädchen geht", "ascii row"])
+        assert out.shape[0] == 2  # fallback produced the batch
+
+    def test_no_sos_eos_variant(self, monkeypatch):
+        from machine_learning_apache_spark_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        pipe = self._pipes("word_punct", add_sos=False, add_eos=False)
+        got = pipe(self.TORTURE)
+        monkeypatch.setenv("MLSPARK_NO_NATIVE_TEXT", "1")
+        np.testing.assert_array_equal(got, pipe(self.TORTURE))
+
+    def test_recipes_end_to_end_unchanged(self):
+        """The fixture AG_NEWS corpus (all-ASCII) encodes identically
+        through the dispatching pipeline and the forced-Python one."""
+        import os
+
+        fixtures = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "assets", "fixtures",
+        )
+        if not os.path.isdir(fixtures):
+            pytest.skip("fixtures not generated")
+        from machine_learning_apache_spark_tpu.data.datasets import load_ag_news
+        from machine_learning_apache_spark_tpu.data.text import (
+            classification_pipeline,
+        )
+
+        texts, _ = load_ag_news(fixtures, train=True)
+        pipe = classification_pipeline(texts, max_seq_len=48, fixed_len=49)
+        got = pipe(texts)
+        os.environ["MLSPARK_NO_NATIVE_TEXT"] = "1"
+        try:
+            want = pipe(texts)
+        finally:
+            del os.environ["MLSPARK_NO_NATIVE_TEXT"]
+        np.testing.assert_array_equal(got, want)
