@@ -88,6 +88,41 @@ def _write_idx(path: str, arr: np.ndarray) -> None:
         f.write(arr.tobytes())
 
 
+def _draw_cifar(rng: np.random.Generator, label: int) -> np.ndarray:
+    """A 32×32×3 'photo': per-class hue + a class-dependent shape over a
+    noisy background — CIFAR-like structure, learnable by TinyVGG."""
+    img = rng.normal(0.35, 0.1, (32, 32, 3)).astype(np.float32)
+    hue = np.zeros(3, np.float32)
+    hue[label % 3] = 0.5
+    hue[(label // 3) % 3] += 0.25
+    r0 = 4 + int(rng.integers(-2, 3))
+    c0 = 4 + int(rng.integers(-2, 3))
+    size = 14 + (label % 5) * 2
+    if label % 2 == 0:  # filled square
+        img[r0 : r0 + size, c0 : c0 + size] += hue
+    else:  # hollow frame
+        img[r0 : r0 + size, c0 : c0 + 3] += hue
+        img[r0 : r0 + size, c0 + size - 3 : c0 + size] += hue
+        img[r0 : r0 + 3, c0 : c0 + size] += hue
+        img[r0 + size - 3 : r0 + size, c0 : c0 + size] += hue
+    return (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+
+
+def make_cifar10(n_train: int = 512, n_test: int = 128) -> None:
+    """CIFAR-10 binary layout: 3073-byte records (1 label + 3072 CHW)."""
+    rng = np.random.default_rng(99)
+    out = os.path.join(HERE, "cifar-10-batches-bin")
+    os.makedirs(out, exist_ok=True)
+    for name, n in (("data_batch_1.bin", n_train), ("test_batch.bin", n_test)):
+        with open(os.path.join(out, name), "wb") as f:
+            for _ in range(n):
+                label = int(rng.integers(0, 10))
+                img = _draw_cifar(rng, label)  # HWC
+                f.write(bytes([label]))
+                f.write(img.transpose(2, 0, 1).tobytes())  # stored CHW
+    print(f"CIFAR-10 fixture: {n_train} train / {n_test} test → {out}")
+
+
 def make_fashion_mnist(n_train: int = 640, n_test: int = 160) -> None:
     rng = np.random.default_rng(42)
     out = os.path.join(HERE, "FashionMNIST", "raw")
@@ -238,3 +273,4 @@ if __name__ == "__main__":
     make_fashion_mnist()
     make_ag_news()
     make_multi30k()
+    make_cifar10()

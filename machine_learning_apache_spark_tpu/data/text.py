@@ -341,14 +341,17 @@ class TextPipeline:
 
         if _os.environ.get("MLSPARK_NO_NATIVE_TEXT"):
             return None
-        mode = None
-        name = self.spec["tokenizer"]
-        if name in ("basic_english", "word_punct"):
-            # Only when the name still resolves to the built-in — a
-            # register_tokenizer(overwrite=True) shadow must win.
-            if self.tokenizer is _TOKENIZERS.get(name):
-                mode = {"basic_english": 0, "word_punct": 1}[name]
-        if mode is None or self.spec["fixed_len"] is None or not texts:
+        # Only for the ACTUAL built-in functions — comparing against the
+        # registry entry would pass a custom tokenizer registered over a
+        # builtin name before the pipeline was built, silently encoding
+        # with builtin semantics against a custom-tokenized vocab.
+        if self.tokenizer is basic_english:
+            mode = 0
+        elif self.tokenizer is word_punct:
+            mode = 1
+        else:
+            return None
+        if self.spec["fixed_len"] is None or not texts:
             return None
         if not all(isinstance(t, str) and t.isascii() for t in texts):
             return None

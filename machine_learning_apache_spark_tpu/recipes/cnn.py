@@ -16,6 +16,7 @@ import jax
 
 from machine_learning_apache_spark_tpu.data import ArrayDataset
 from machine_learning_apache_spark_tpu.data.datasets import (
+    load_cifar10,
     load_fashion_mnist,
     synthetic_image_classification,
 )
@@ -47,7 +48,12 @@ class CNNRecipe:
     learning_rate: float = 0.01
     batch_size: int = 32
     seed: int = 0
-    data_root: str | None = None  # FashionMNIST idx files; None → synthetic
+    data_root: str | None = None  # dataset files under here; None → synthetic
+    # "fashion_mnist" (the reference workload, 28×28×1 idx files) or
+    # "cifar10" (the BASELINE.json distributed-CNN target, 32×32×3 binary
+    # batches). TinyVGG is input-shape agnostic; the synthetic stand-in
+    # matches whichever shape is selected.
+    dataset: str = "fashion_mnist"
     synthetic_n: int = 4096
     use_mesh: bool = True
     log_every: int = 0
@@ -73,16 +79,26 @@ def train_cnn(
 ) -> dict:
     r = with_overrides(recipe or CNNRecipe(), overrides)
 
+    loaders = {"fashion_mnist": load_fashion_mnist, "cifar10": load_cifar10}
+    if r.dataset not in loaders:
+        raise ValueError(
+            f"dataset must be one of {sorted(loaders)}, got {r.dataset!r}"
+        )
     if r.data_root:
-        train_frame = load_fashion_mnist(r.data_root, train=True)
-        test_frame = load_fashion_mnist(r.data_root, train=False)
+        train_frame = loaders[r.dataset](r.data_root, train=True)
+        test_frame = loaders[r.dataset](r.data_root, train=False)
     else:
+        shape = (
+            dict(height=32, width=32, channels=3)
+            if r.dataset == "cifar10"
+            else dict(height=28, width=28, channels=1)
+        )
         train_frame = synthetic_image_classification(
-            r.synthetic_n, num_classes=r.num_classes, seed=r.seed
+            r.synthetic_n, num_classes=r.num_classes, seed=r.seed, **shape
         )
         test_frame = synthetic_image_classification(
             max(r.synthetic_n // 4, 128), num_classes=r.num_classes,
-            seed=r.seed + 1,
+            seed=r.seed + 1, **shape,
         )
     train_ds = ArrayDataset(*train_frame.arrays())
     test_ds = ArrayDataset(*test_frame.arrays())

@@ -42,6 +42,16 @@ class TestFixtureLoaders:
         # quoted-comma rows survive csv parsing as one description field
         assert all(isinstance(t, str) and len(t.split()) >= 4 for t in texts)
 
+    def test_cifar10_binary(self):
+        from machine_learning_apache_spark_tpu.data.datasets import load_cifar10
+
+        train = load_cifar10(FIXTURES, train=True)
+        imgs, lbls = train.arrays()
+        assert imgs.shape == (512, 32, 32, 3) and imgs.dtype == np.float32
+        assert float(imgs.min()) >= 0.0 and float(imgs.max()) <= 1.0
+        assert set(np.unique(lbls)) <= set(range(10))
+        assert load_cifar10(FIXTURES, train=False).arrays()[0].shape[0] == 128
+
     def test_multi30k_parallel(self):
         from machine_learning_apache_spark_tpu.data.datasets import load_multi30k
 
@@ -91,6 +101,17 @@ class TestFixtureTraining:
         )
         assert out["history"][-1]["loss"] < out["history"][0]["loss"]
         assert out["accuracy"] > 0.3  # 10-class silhouettes, 2 epochs
+
+    def test_cnn_on_fixture_cifar10(self):
+        """The BASELINE.json distributed-CNN shape (32×32×3) through the
+        same recipe: dataset="cifar10" selects the binary-batch loader."""
+        from machine_learning_apache_spark_tpu.recipes.cnn import train_cnn
+
+        out = train_cnn(
+            epochs=2, batch_size=32, data_root=FIXTURES, dataset="cifar10",
+            log_every=0, use_mesh=False,
+        )
+        assert out["history"][-1]["loss"] < out["history"][0]["loss"]
 
     def test_lstm_on_fixture_csv(self):
         from machine_learning_apache_spark_tpu.recipes.lstm import train_lstm

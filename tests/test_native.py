@@ -229,7 +229,7 @@ class TestTextEncode:
         monkeypatch.setenv("MLSPARK_NO_NATIVE_TEXT", "1")
         np.testing.assert_array_equal(got, pipe(self.TORTURE))
 
-    def test_recipes_end_to_end_unchanged(self):
+    def test_recipes_end_to_end_unchanged(self, monkeypatch):
         """The fixture AG_NEWS corpus (all-ASCII) encodes identically
         through the dispatching pipeline and the forced-Python one."""
         import os
@@ -240,17 +240,49 @@ class TestTextEncode:
         )
         if not os.path.isdir(fixtures):
             pytest.skip("fixtures not generated")
+        from machine_learning_apache_spark_tpu import native
         from machine_learning_apache_spark_tpu.data.datasets import load_ag_news
         from machine_learning_apache_spark_tpu.data.text import (
             classification_pipeline,
         )
 
+        if not native.available():
+            pytest.skip("native library unavailable")
+        monkeypatch.delenv("MLSPARK_NO_NATIVE_TEXT", raising=False)
         texts, _ = load_ag_news(fixtures, train=True)
         pipe = classification_pipeline(texts, max_seq_len=48, fixed_len=49)
         got = pipe(texts)
-        os.environ["MLSPARK_NO_NATIVE_TEXT"] = "1"
-        try:
-            want = pipe(texts)
-        finally:
-            del os.environ["MLSPARK_NO_NATIVE_TEXT"]
+        monkeypatch.setenv("MLSPARK_NO_NATIVE_TEXT", "1")
+        want = pipe(texts)
         np.testing.assert_array_equal(got, want)
+
+    def test_prebuild_shadow_uses_custom_tokenizer(self):
+        """A custom tokenizer registered OVER a builtin name before the
+        pipeline is built must route to the Python path — the C++ builtin
+        semantics would silently mis-encode against the custom vocab."""
+        from machine_learning_apache_spark_tpu.data import text as text_mod
+        from machine_learning_apache_spark_tpu.data.text import (
+            TextPipeline,
+            register_tokenizer,
+        )
+
+        def shouty(s):
+            return ["X" + w for w in s.split()]
+
+        register_tokenizer("word_punct", shouty, overwrite=True)
+        try:
+            pipe = TextPipeline.fit(
+                ["hello there world"], "word_punct",
+                max_seq_len=8, fixed_len=10,
+            )
+            out = pipe(["hello there"])
+            # Xhello/Xthere are real vocab entries only under the custom
+            # tokenizer; builtin C++ word_punct would emit OOV ids.
+            ids = [i for i in out[0].tolist() if i > 3]
+            assert ids == pipe.vocab.lookup_indices(["Xhello", "Xthere"])
+        finally:
+            from machine_learning_apache_spark_tpu.data.text import (
+                word_punct,
+            )
+
+            register_tokenizer("word_punct", word_punct, overwrite=True)
