@@ -1,0 +1,15 @@
+"""Shared helpers for the example entry points."""
+
+import os
+
+
+def dist_platform() -> str | None:
+    """Backend for locally-spawned gangs (``Distributor(local_mode=True)``).
+
+    Defaults to the CPU backend: N colocated processes cannot share one TPU
+    chip (a chip binds to a single process). On real TPU hardware set
+    ``MLSPARK_DIST_PLATFORM=`` (empty) with one process per host — or drive
+    ``Distributor.commands_for_hosts`` from the cluster scheduler — and
+    each process claims its host's chips via the default platform.
+    """
+    return os.environ.get("MLSPARK_DIST_PLATFORM", "cpu") or None

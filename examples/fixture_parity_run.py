@@ -46,6 +46,23 @@ def run_cnn() -> dict:
     }
 
 
+def run_cnn_cifar() -> dict:
+    """Same recipe, the BASELINE.json distributed-CNN shape: TinyVGG on the
+    CIFAR-10-format binary fixture (32×32×3)."""
+    from machine_learning_apache_spark_tpu.recipes.cnn import train_cnn
+
+    out = train_cnn(
+        data_root=FIXTURES, dataset="cifar10", log_every=0, use_mesh=False
+    )
+    return {
+        "epoch_losses": [round(h["loss"], 4) for h in out["history"]],
+        "accuracy": round(float(out["accuracy"]), 4),
+        "test_loss": round(float(out["test_loss"]), 4),
+        "train_seconds": round(out["train_seconds"], 2),
+        "eval_samples": out["eval_samples"],
+    }
+
+
 def run_lstm() -> dict:
     """``pytorch_lstm.py`` hypers: LSTM(32, 2 layers), Adam 1e-3, bs 32,
     3 epochs, seq 128 — on the fixture AG_NEWS csv."""
@@ -85,6 +102,7 @@ def main() -> None:
     result = {"fixtures": FIXTURES}
     for name, fn in (
         ("cnn", run_cnn),
+        ("cnn_cifar10", run_cnn_cifar),
         ("lstm", run_lstm),
         ("translation", run_translation),
     ):
