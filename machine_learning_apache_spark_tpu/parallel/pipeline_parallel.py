@@ -6,8 +6,11 @@ microbatch schedule expressed as one compiled SPMD program, the idiomatic
 TPU form (no per-stage processes, no send/recv runtime — ``shard_map`` +
 ``ppermute`` and a ``lax.scan`` over schedule ticks).
 
-Layout: the mesh's ``pipeline`` axis has one device (group) per stage; each
-holds only its own stage's params (1/n of the model). The global batch is
+Layout: the mesh's ``pipeline`` axis has one device (group) per stage; the
+``stage_params`` operand enters the shard_map split over its leading stage
+dim, so each stage materializes only its own slice inside the schedule
+(caller-held state outside may still be replicated — see
+``pipeline_transformer``'s memory note). The global batch is
 split into M microbatches. On tick t, stage s applies itself to the
 activations of microbatch t−s and passes the result to stage s+1 via a
 single-hop ``ppermute`` — after M + S − 1 ticks every microbatch has

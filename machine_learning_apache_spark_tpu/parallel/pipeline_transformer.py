@@ -13,6 +13,14 @@ the microbatch it is currently processing.
 Composes with data parallelism (a ``data`` axis on the same mesh shards the
 microbatch dim); TP/SP/EP inside a pipeline stage are out of scope and
 rejected by ``pipeline_apply``.
+
+Memory honesty: this pipelines COMPUTE and activations — inside the
+shard_map each stage materializes only its own stage's (stacked) layer
+params — but the TrainState itself (params + optimizer moments) stays
+replicated across the mesh, DDP-style, because the zoo stores layers as
+separate named subtrees that per-leaf PartitionSpecs cannot split across
+stages. A true 1/n-params layout needs the scan-over-layers (stacked
+leaf) model form and is the documented follow-up, not a current claim.
 """
 
 from __future__ import annotations
