@@ -137,7 +137,13 @@ def _divisible_sharding(sharding: NamedSharding, x, name: str = "") -> NamedShar
     return NamedSharding(mesh, P(*entries)) if changed else sharding
 
 
-def shard_state(state: Any, mesh: Mesh, rules: Mapping[str, str | None] | None = None) -> Any:
+def shard_state(
+    state: Any,
+    mesh: Mesh,
+    rules: Mapping[str, str | None] | None = None,
+    *,
+    zero1: bool = False,
+) -> Any:
     """Place a ``TrainState`` (or any pytree) per its logical annotations.
 
     Boxed params land tensor-sharded over the mesh's ``"model"`` axis,
@@ -147,15 +153,46 @@ def shard_state(state: Any, mesh: Mesh, rules: Mapping[str, str | None] | None =
     (``distributed_cnn.py:156``), while a dp×tp mesh gets Megatron-style
     layouts with no train-step change. Dims whose size the mesh axis does
     not divide fall back to replication (see ``_divisible_sharding``).
+
+    ``zero1=True`` additionally shards OPTIMIZER-STATE leaves (everything
+    under ``opt_state``) over the ``"data"`` axis on their leading dim —
+    ZeRO stage 1: each data replica stores 1/N of the Adam moments instead
+    of a full copy. The update math is untouched: the train step stays the
+    plain jitted step, and XLA's sharding propagation inserts the gathers
+    where a moment meets a replicated grad/param (trajectories equal up to
+    float32 reduction-order noise — pinned by
+    ``tests/test_tensor_parallel.py``). Leaves
+    whose leading dim the data axis does not divide, scalar counters, and
+    dims already sharded by a logical rule are left as-is.
     """
     unboxed = nn.unbox(state)
     specs = nn.get_partition_spec(state)
+    data_ways = mesh.shape.get(DATA_AXIS, 1)
+    if zero1 and data_ways <= 1:
+        # Never a silent no-op: the user asked for sharded optimizer state
+        # and would size a real job on that memory budget.
+        raise ValueError(
+            f"zero1=True requires a mesh with a >1 {DATA_AXIS!r} axis; got "
+            f"mesh shape {dict(mesh.shape)}"
+        )
+
+    def _is_opt_leaf(path) -> bool:
+        return bool(path) and getattr(path[0], "name", None) == "opt_state"
 
     def place(path, spec, x):
         # get_partition_spec yields None (not P()) for non-array leaves like
         # the step counter — an empty-pytree landmine under tree.map, so it
         # is treated as a leaf here and replicated.
         p = logical_to_mesh_spec(spec, mesh, rules) if isinstance(spec, P) else P()
+        if (
+            zero1
+            and _is_opt_leaf(path)
+            and getattr(x, "ndim", 0) >= 1
+            and (len(p) == 0 or p[0] is None)
+        ):
+            # Divisibility is NOT pre-checked here: _divisible_sharding
+            # below replicates non-divisible dims LOUDLY, per its contract.
+            p = P(DATA_AXIS, *tuple(p)[1:])
         name = jax.tree_util.keystr(path)
         return jax.device_put(
             x, _divisible_sharding(NamedSharding(mesh, p), x, name)

@@ -90,6 +90,10 @@ class TranslationRecipe:
     # the backward instead of saving them — the FLOPs-for-HBM trade for
     # long-context / deep-stack training.
     remat: bool = False
+    # ZeRO stage 1: shard optimizer moments 1/N over the mesh "data" axis
+    # (each replica stores its slice of the Adam state instead of a full
+    # copy; XLA inserts the gathers). Same math, less HBM per chip.
+    zero1: bool = False
     # Training-scale knobs beyond the reference's fixed-lr Adam: lr schedule
     # ("constant" | "cosine" | "warmup_cosine" over the full run), linear
     # warmup steps, global-norm gradient clipping, and gradient accumulation
@@ -399,6 +403,7 @@ def train_translator(
                 checkpointer=ckpt,
                 checkpoint_every=r.checkpoint_every,
                 metrics_file=r.metrics_path,
+                zero1=r.zero1,
             )
             metrics = evaluate(
                 result.state,

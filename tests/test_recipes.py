@@ -202,6 +202,34 @@ class TestParallelismFlags:
         assert out["history"][-1]["loss"] < out["history"][0]["loss"]
         assert "test_loss" in out
 
+    def test_zero1_recipe(self):
+        """ZeRO-1 reachable from the recipe surface: optimizer moments
+        shard over "data" and the run still learns."""
+        import jax as _jax
+
+        from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+
+        out = train_translator(
+            epochs=1, synthetic_n=128, batch_size=8, max_len=16,
+            d_model=32, ffn_hidden=64, num_heads=4, log_every=0,
+            zero1=True, _return_state=True,
+        )
+        assert out["history"][-1]["loss"] < 7.0
+        specs = [
+            tuple(leaf.sharding.spec)
+            for leaf in _jax.tree.leaves(out["state"].opt_state)
+            if getattr(leaf, "ndim", 0) >= 1
+        ]
+        assert any(DATA_AXIS in _jax.tree.leaves(s) for s in specs), specs
+        # Dead-flag convention: zero1 without a mesh must fail loudly, not
+        # silently train with replicated moments.
+        with pytest.raises(ValueError, match="zero1"):
+            train_translator(
+                epochs=1, synthetic_n=64, batch_size=8, max_len=16,
+                d_model=32, ffn_hidden=64, num_heads=4, log_every=0,
+                zero1=True, use_mesh=False,
+            )
+
     def test_pipeline_parallel_validation(self):
         with pytest.raises(ValueError, match="pipeline stages"):
             train_translator(
