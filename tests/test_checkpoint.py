@@ -256,6 +256,36 @@ class TestShardedResume:
         assert math.isfinite(second["final_loss"])
 
 
+class TestZero1Resume:
+    def test_zero1_resume_keeps_moment_sharding(self, tmp_path):
+        """Resume a run whose Adam moments are ZeRO-1-sharded: the second
+        run's shard_state(zero1=True) must lay the RESTORED moments back
+        out over the data axis, and training continues finitely."""
+        import math
+
+        from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+        from machine_learning_apache_spark_tpu.recipes.translation import (
+            train_translator,
+        )
+
+        kw = dict(
+            epochs=1, synthetic_n=128, batch_size=8, max_len=16,
+            d_model=32, ffn_hidden=64, num_heads=4, log_every=0,
+            zero1=True, checkpoint_dir=str(tmp_path / "z1"),
+        )
+        first = train_translator(**kw)
+        assert "resumed_from_step" not in first
+        second = train_translator(**kw, _return_state=True)
+        assert second["resumed_from_step"] > 0
+        specs = [
+            tuple(leaf.sharding.spec)
+            for leaf in jax.tree.leaves(second["state"].opt_state)
+            if getattr(leaf, "ndim", 0) >= 1
+        ]
+        assert any(DATA_AXIS in jax.tree.leaves(s) for s in specs), specs
+        assert math.isfinite(second["final_loss"])
+
+
 class TestParamsOnly:
     def test_save_load(self, tmp_path):
         state = make_state()
