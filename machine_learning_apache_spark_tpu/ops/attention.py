@@ -38,8 +38,18 @@ _SEQ_PARALLEL_CTX: list[tuple] = []
 
 
 @contextlib.contextmanager
-def sequence_parallel(mesh, *, seq_axis: str = "seq", batch_axis: str = "data"):
-    """Route zoo self-attention through ring attention on ``mesh``.
+def sequence_parallel(
+    mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axis: str = "data",
+    method: str = "ring",
+):
+    """Route zoo self-attention through sequence-parallel attention on
+    ``mesh`` — ``method="ring"`` (K/V chunks rotate via ``ppermute``; any
+    head count) or ``method="ulysses"`` (head↔sequence ``all_to_all``;
+    needs ``num_heads % seq_axis_size == 0`` — see
+    ``parallel.ulysses_attention`` for the trade).
 
     Usage (a dp×sp mesh; no model change):
 
@@ -48,12 +58,16 @@ def sequence_parallel(mesh, *, seq_axis: str = "seq", batch_axis: str = "data"):
 
     Dispatch per attention site (see ``dot_product_attention``): structured-
     mask self-attention whose sequence length divides the ``seq_axis`` size
-    goes through the ring; cross-attention, decode steps, and dense-mask
-    sites fall through to their usual paths.
+    goes through the selected mechanism; cross-attention, decode steps, and
+    dense-mask sites fall through to their usual paths.
     """
     if seq_axis not in mesh.shape:
         raise ValueError(f"mesh {dict(mesh.shape)} has no '{seq_axis}' axis")
-    _SEQ_PARALLEL_CTX.append((mesh, seq_axis, batch_axis))
+    if method not in ("ring", "ulysses"):
+        raise ValueError(
+            f"method must be 'ring' or 'ulysses', got {method!r}"
+        )
+    _SEQ_PARALLEL_CTX.append((mesh, seq_axis, batch_axis, method))
     try:
         yield
     finally:
@@ -146,11 +160,30 @@ def dot_product_attention(
         # through to the dense path instead of crashing shard_map).
         and query.shape[0] % ctx[0].shape.get(ctx[2], 1) == 0
     ):
+        mesh, seq_axis, batch_axis, method = ctx
+        if method == "ulysses":
+            # A head count the seq axis cannot divide is a model-config
+            # error, not a fall-through case: silently running the ring (or
+            # dense) would misrepresent which mechanism executed.
+            if query.shape[1] % mesh.shape[seq_axis]:
+                raise ValueError(
+                    f"sequence_parallel(method='ulysses') needs num_heads "
+                    f"({query.shape[1]}) divisible by the {seq_axis!r} axis "
+                    f"({mesh.shape[seq_axis]}); use method='ring'"
+                )
+            from machine_learning_apache_spark_tpu.parallel.ulysses_attention import (
+                ulysses_attention,
+            )
+
+            return ulysses_attention(
+                query, key, value, mesh,
+                causal=causal, kv_valid=kv_valid,
+                seq_axis=seq_axis, batch_axis=batch_axis,
+            )
         from machine_learning_apache_spark_tpu.parallel.ring_attention import (
             ring_attention,
         )
 
-        mesh, seq_axis, batch_axis = ctx
         return ring_attention(
             query, key, value, mesh,
             causal=causal, kv_valid=kv_valid,

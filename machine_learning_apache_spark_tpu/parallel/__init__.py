@@ -35,6 +35,9 @@ from machine_learning_apache_spark_tpu.ops.attention import sequence_parallel
 from machine_learning_apache_spark_tpu.parallel.ring_attention import (
     ring_attention,
 )
+from machine_learning_apache_spark_tpu.parallel.ulysses_attention import (
+    ulysses_attention,
+)
 from machine_learning_apache_spark_tpu.parallel.tensor_parallel import (
     DEFAULT_RULES,
     logical_to_mesh_spec,
@@ -63,6 +66,7 @@ __all__ = [
     "pipeline_apply",
     "pipeline_transformer_logits",
     "ring_attention",
+    "ulysses_attention",
     "sequence_parallel",
     "DEFAULT_RULES",
     "logical_to_mesh_spec",

@@ -67,6 +67,10 @@ class TranslationRecipe:
     # through to the dense/flash path).
     model_parallel: int = 1
     sequence_parallel: int = 1
+    # Sequence-parallel mechanism: "ring" (ppermute K/V rotation; any head
+    # count) or "ulysses" (head↔sequence all_to_all; needs num_heads %
+    # sequence_parallel == 0 — fewer, larger collectives).
+    sequence_parallel_method: str = "ring"
     # GPipe-style pipeline parallelism over a mesh "pipeline" axis: the
     # encoder and decoder layer stacks each run as a microbatched ppermute
     # ring (parallel.pipeline_transformer), embeddings/LM-head outside the
@@ -355,8 +359,15 @@ def train_translator(
         sequence_parallel,
     )
 
+    if r.sequence_parallel > 1 and r.sequence_parallel_method == "ulysses":
+        if r.num_heads % r.sequence_parallel:
+            raise ValueError(
+                f"sequence_parallel_method='ulysses' needs num_heads "
+                f"({r.num_heads}) divisible by sequence_parallel "
+                f"({r.sequence_parallel}); use 'ring'"
+            )
     sp_ctx = (
-        sequence_parallel(mesh)
+        sequence_parallel(mesh, method=r.sequence_parallel_method)
         if mesh is not None and r.sequence_parallel > 1
         else contextlib.nullcontext()
     )

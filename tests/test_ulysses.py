@@ -1,0 +1,179 @@
+"""Ulysses sequence parallelism: exact parity with dense attention on the
+8-virtual-device CPU mesh, dispatch routing, and validation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu.ops.attention import (
+    scaled_dot_product_attention,
+    sequence_parallel,
+)
+from machine_learning_apache_spark_tpu.ops.masks import (
+    combine_masks,
+    make_causal_mask,
+)
+from machine_learning_apache_spark_tpu.parallel import make_mesh
+from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+from machine_learning_apache_spark_tpu.parallel.ulysses_attention import (
+    ulysses_attention,
+)
+
+
+def _qkv(b=2, h=8, s=16, d=4, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (b, h, s, d)) for k in ks)
+
+
+def _dense(q, k, v, causal=False, kv_valid=None):
+    mask = None
+    if kv_valid is not None:
+        mask = kv_valid[:, None, None, :]
+    if causal:
+        mask = combine_masks(mask, make_causal_mask(q.shape[2]))
+    return scaled_dot_product_attention(q, k, v, mask)
+
+
+class TestUlyssesParity:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+        q, k, v = _qkv()
+        out = ulysses_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_dense(q, k, v, causal)), atol=1e-5
+        )
+
+    def test_kv_valid_rides(self):
+        mesh = make_mesh({SEQ_AXIS: 8})
+        q, k, v = _qkv(b=3, h=8, s=24)
+        valid = jax.random.uniform(jax.random.key(7), (3, 24)) > 0.3
+        valid = valid.at[:, 0].set(True)  # no fully-padded rows here
+        out = ulysses_attention(q, k, v, mesh, causal=True, kv_valid=valid)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(_dense(q, k, v, causal=True, kv_valid=valid)),
+            atol=1e-5,
+        )
+
+    def test_fully_padded_rows_emit_zeros(self):
+        """The ring/flash convention on every backend: an all-pad row
+        outputs exact zeros, never the mean of V."""
+        from machine_learning_apache_spark_tpu.parallel.ring_attention import (
+            ring_attention,
+        )
+
+        mesh = make_mesh({SEQ_AXIS: 8})
+        q, k, v = _qkv(b=2, h=8, s=16)
+        valid = jnp.ones((2, 16), bool).at[1, :].set(False)  # row 1 all pad
+        out_u = ulysses_attention(q, k, v, mesh, causal=True, kv_valid=valid)
+        out_r = ring_attention(q, k, v, mesh, causal=True, kv_valid=valid)
+        assert bool((out_u[1] == 0.0).all())
+        np.testing.assert_allclose(
+            np.asarray(out_u), np.asarray(out_r), atol=1e-5
+        )
+
+    def test_gradients_match_dense(self):
+        mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+        q, k, v = _qkv(h=4)
+        g_u = jax.grad(
+            lambda q: (ulysses_attention(q, k, v, mesh, causal=True) ** 2).sum()
+        )(q)
+        g_d = jax.grad(
+            lambda q: (_dense(q, k, v, causal=True) ** 2).sum()
+        )(q)
+        np.testing.assert_allclose(np.asarray(g_u), np.asarray(g_d), atol=1e-4)
+
+    def test_jit(self):
+        mesh = make_mesh({SEQ_AXIS: 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(h=4, s=12)
+        out = jax.jit(
+            lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=True)
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_dense(q, k, v, causal=True)),
+            atol=1e-5,
+        )
+
+
+class TestUlyssesValidation:
+    def test_head_divisibility(self):
+        mesh = make_mesh({SEQ_AXIS: 8})
+        q, k, v = _qkv(h=6)  # 6 % 8 != 0
+        with pytest.raises(ValueError, match="num_heads"):
+            ulysses_attention(q, k, v, mesh)
+
+    def test_seq_divisibility(self):
+        mesh = make_mesh({SEQ_AXIS: 8})
+        q, k, v = _qkv(s=12)  # 12 % 8 != 0
+        with pytest.raises(ValueError, match="not divisible"):
+            ulysses_attention(q, k, v, mesh)
+
+    def test_method_validated(self):
+        mesh = make_mesh({SEQ_AXIS: 8})
+        with pytest.raises(ValueError, match="method"):
+            with sequence_parallel(mesh, method="spiral"):
+                pass
+
+
+class TestUlyssesDispatch:
+    def test_context_routes_to_ulysses(self, monkeypatch):
+        """sequence_parallel(method='ulysses') engages the all_to_all path
+        (counted — a silent fall-through to ring/dense must fail)."""
+        import importlib
+
+        ua = importlib.import_module(
+            "machine_learning_apache_spark_tpu.parallel.ulysses_attention"
+        )
+        calls = {"n": 0}
+        orig = ua.ulysses_attention
+
+        def counting(*args, **kwargs):
+            calls["n"] += 1
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(ua, "ulysses_attention", counting)
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            dot_product_attention,
+        )
+
+        mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+        q, k, v = _qkv()
+        with sequence_parallel(mesh, method="ulysses"):
+            out = dot_product_attention(q, k, v, causal=True)
+        assert calls["n"] == 1
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_dense(q, k, v, causal=True)),
+            atol=1e-5,
+        )
+
+    def test_indivisible_heads_raise_in_dispatch(self):
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            dot_product_attention,
+        )
+
+        mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+        q, k, v = _qkv(h=6)  # 6 % 4 != 0
+        with sequence_parallel(mesh, method="ulysses"):
+            with pytest.raises(ValueError, match="ulysses"):
+                dot_product_attention(q, k, v, causal=True)
+
+    def test_recipe_flag(self):
+        """sequence_parallel_method reachable from the recipe surface."""
+        from machine_learning_apache_spark_tpu.recipes.translation import (
+            train_translator,
+        )
+
+        out = train_translator(
+            epochs=1, synthetic_n=128, batch_size=8, max_len=16,
+            d_model=32, ffn_hidden=64, num_heads=4, log_every=0,
+            sequence_parallel=4, sequence_parallel_method="ulysses",
+        )
+        assert out["history"][-1]["loss"] < 7.0
+        with pytest.raises(ValueError, match="ulysses"):
+            train_translator(
+                epochs=1, synthetic_n=64, batch_size=8, max_len=16,
+                d_model=30, ffn_hidden=64, num_heads=6, log_every=0,
+                sequence_parallel=4, sequence_parallel_method="ulysses",
+            )
