@@ -190,6 +190,45 @@ def _time_trials(step_fn, n_trials: int, n_steps: int, ready_fn) -> list[float]:
     return times
 
 
+def _value_barrier(holder) -> float:
+    """Completion barrier that an async dispatch layer cannot satisfy early:
+    transfer the trial's final loss scalar AND one element of an updated
+    param to the host. Those bytes depend on the whole step chain (the loss
+    on the last forward over 19 prior updates, the param element on the last
+    optimizer update), so the fetch cannot return before every dispatched
+    step has actually executed.
+
+    Why not ``jax.block_until_ready``: under the tunneled dev-chip relay it
+    has been observed returning after *enqueue*, not completion — producing
+    physically impossible rates (BENCH_r02's 4.2M tok/s/chip; a first r04
+    run printed 73M tok/s/chip ≈ 2468% MFU on the same workload). A literal
+    value fetch is the only barrier whose result proves execution happened.
+    Costs one scalar-RPC round-trip per *trial* (not per step) — noise at
+    multi-step trial granularity.
+    """
+    import jax
+
+    leaf = jax.tree.leaves(holder["state"].params)[0]
+    # holder["loss"] exists only after the first step (warmup may be 0).
+    loss = float(holder["loss"]) if "loss" in holder else 0.0
+    return float(leaf.ravel()[0]) + loss
+
+
+def _check_mfu(achieved: float, peak: float | None, label: str) -> float | None:
+    """Reject physically impossible rates instead of reporting them."""
+    if not peak:
+        return None
+    mfu = achieved / peak
+    if mfu > 1.0:
+        # A rate above the chip's peak proves the barrier was defeated (or
+        # the clock/FLOP model is broken) — never report it as a result.
+        raise RuntimeError(
+            f"measured {label} MFU {mfu:.2f} exceeds 1.0 — timing barrier "
+            f"defeated (async-ack relay?); measurement invalid"
+        )
+    return mfu
+
+
 def _degraded_mode_knobs(jax) -> None:
     """On a CPU fallback, shrink the measurement plan so the artifact lands
     within the driver's window: CPU steps are ~100× slower than the chip's,
@@ -313,10 +352,11 @@ def bench_transformer(
 
     for _ in range(warmup):
         one_step()
-    jax.block_until_ready(holder["state"].params)
+    _value_barrier(holder)
+    loss0 = float(holder["loss"]) if "loss" in holder else float("nan")
     log(
         f"jax transformer warmup done on {n_chips} × {device.platform} "
-        f"(bs/chip={batch_per_chip}, layers={layers})"
+        f"(bs/chip={batch_per_chip}, layers={layers}, loss={loss0:.3f})"
     )
 
     if os.environ.get("BENCH_PROFILE_DIR"):
@@ -325,12 +365,11 @@ def bench_transformer(
         with jax.profiler.trace(os.environ["BENCH_PROFILE_DIR"]):
             for _ in range(5):
                 one_step()
-            jax.block_until_ready(holder["state"].params)
+            _value_barrier(holder)
         log(f"profiler trace written to {os.environ['BENCH_PROFILE_DIR']}")
 
     times = _time_trials(
-        one_step, trials, steps,
-        lambda: jax.block_until_ready(holder["state"].params),
+        one_step, trials, steps, lambda: _value_barrier(holder)
     )
     rates = [batch * SEQ * steps / dt / n_chips for dt in times]
     for t, (dt, r) in enumerate(zip(times, rates)):
@@ -341,6 +380,7 @@ def bench_transformer(
     peak = _peak_flops(device)
     median_dt = statistics.median(times)
     achieved = flops_step * steps / median_dt / n_chips
+    mfu = _check_mfu(achieved, peak, "transformer")
     return {
         "median": round(median, 1),
         "max": round(tps[-1], 1),
@@ -348,7 +388,7 @@ def bench_transformer(
         "spread": round(tps[-1] / tps[0], 2) if tps[0] else None,
         "flops_per_step": flops_step,
         "achieved_flops_per_sec_chip": round(achieved, 1),
-        "mfu": round(achieved / peak, 4) if peak else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
         "device": getattr(device, "device_kind", device.platform),
         "n_chips": n_chips,
         "batch_per_chip": batch_per_chip,
@@ -441,18 +481,18 @@ def bench_cnn(jax) -> dict:
 
     for _ in range(3):
         one_step()
-    jax.block_until_ready(holder["state"].params)
+    _value_barrier(holder)
     log(f"jax cnn warmup done ({batch} samples/step)")
 
     times = _time_trials(
-        one_step, CNN_TRIALS, CNN_STEPS,
-        lambda: jax.block_until_ready(holder["state"].params),
+        one_step, CNN_TRIALS, CNN_STEPS, lambda: _value_barrier(holder)
     )
     sps = sorted(batch * CNN_STEPS / dt / n_chips for dt in times)
     median = statistics.median(sps)
     flops_step = cnn_train_flops_per_step(batch)
     peak = _peak_flops(device)
     achieved = flops_step * CNN_STEPS / statistics.median(times) / n_chips
+    mfu = _check_mfu(achieved, peak, "CNN")
     return {
         "value": round(median, 1),
         "unit": "samples/sec/chip",
@@ -460,7 +500,7 @@ def bench_cnn(jax) -> dict:
         "max": round(sps[-1], 1),
         "trials": [round(x, 1) for x in sps],
         "spread": round(sps[-1] / sps[0], 2) if sps[0] else None,
-        "mfu": round(achieved / peak, 4) if peak else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
         "batch_per_chip": CNN_BATCH_PER_CHIP,
     }
 
