@@ -17,6 +17,7 @@ from machine_learning_apache_spark_tpu.parallel.mesh import (
     replicate,
     replicated_sharding,
     shard_batch,
+    shard_batch_stack,
 )
 from machine_learning_apache_spark_tpu.parallel.data_parallel import (
     assert_replicas_in_sync,
@@ -58,6 +59,7 @@ __all__ = [
     "replicate",
     "replicated_sharding",
     "shard_batch",
+    "shard_batch_stack",
     "assert_replicas_in_sync",
     "make_data_parallel_eval_step",
     "make_data_parallel_step",

@@ -110,6 +110,28 @@ def shard_batch(mesh: Mesh, batch, *, axis: str = DATA_AXIS):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
+def shard_batch_stack(mesh: Mesh, batches: list, *, axis: str = DATA_AXIS):
+    """Stack K host batches into one ``[K, batch, ...]`` pytree for the
+    scanned multi-step trainer (``train.loop.make_multi_step``): the scan
+    axis (dim 0) replicated, each step's batch dim (dim 1) sharded over the
+    data axis exactly as ``shard_batch`` would shard it alone.
+
+    Multi-process: each process contributes ``[K, local_batch, ...]`` and
+    the global array is assembled per-shard, same contract as
+    ``shard_batch``.
+    """
+    stacked = jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches
+    )
+    sharding = NamedSharding(mesh, P(None, axis))
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            stacked,
+        )
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
+
+
 def replicate(mesh: Mesh, tree):
     sharding = replicated_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

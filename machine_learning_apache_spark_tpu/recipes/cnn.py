@@ -69,6 +69,11 @@ class CNNRecipe:
     # Structured observability: append per-epoch + end-of-run JSON lines
     # (train.metrics.MetricsLogger) alongside the print vocabulary.
     metrics_path: str | None = None
+    # K batches per host dispatch via the scanned trainer (lax.scan inside
+    # one XLA program — same math/rng stream, K× fewer dispatches). The
+    # throughput lever for this model class: TinyVGG's step is sub-ms on a
+    # TPU, so per-step dispatch caps utilization (see bench.py bench_cnn).
+    steps_per_call: int = 1
 
 
 def train_cnn(
@@ -134,6 +139,7 @@ def train_cnn(
             checkpointer=ckpt,
             checkpoint_every=r.checkpoint_every,
             metrics_file=r.metrics_path,
+            steps_per_call=r.steps_per_call,
         )
     metrics = evaluate(
         result.state,

@@ -52,6 +52,41 @@ def make_train_step(loss_fn: LossFn):
     return step
 
 
+def make_multi_step(loss_fn: LossFn):
+    """K fused train steps per host dispatch, scanned inside ONE XLA program.
+
+    Why this exists: every ``step(...)`` call costs a host dispatch (an RPC
+    round-trip on tunneled/remote device topologies — measured ~2.3 ms/step
+    against a 0.65 ms device step for the TinyVGG workload, i.e. the host
+    caps a small model at ~30% of the chip). ``lax.scan`` moves the step
+    loop into the compiled program: one dispatch covers K steps, the device
+    runs back-to-back, and the host has K step-times to enqueue the next
+    call. The K microbatches arrive stacked on a leading axis
+    (``parallel.shard_batch_stack``); K is implicit in the shapes.
+
+    Rng contract: the body splits exactly like ``fit``'s host loop
+    (``rng, step_rng = split(rng)`` per step) and the advanced key is
+    returned, so a run produces bit-identical params whether dispatched
+    one step at a time or K at a time (pinned by
+    ``tests/test_train.py::TestStepsPerCall``).
+    """
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def multi_step(state: TrainState, batches, rng: jax.Array):
+        def body(carry, batch):
+            state, rng = carry
+            rng, step_rng = jax.random.split(rng)
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch, step_rng
+            )
+            return (state.apply_gradients(grads), rng), (loss, aux)
+
+        (state, rng), (losses, auxes) = jax.lax.scan(body, (state, rng), batches)
+        return state, rng, losses, auxes
+
+    return multi_step
+
+
 def make_eval_step(loss_fn: LossFn):
     @jax.jit
     def step(state: TrainState, batch, rng: jax.Array):
@@ -88,6 +123,7 @@ def fit(
     metrics_file: str | None = None,
     sync_check_every: int = 0,
     zero1: bool = False,
+    steps_per_call: int = 1,
 ) -> FitResult:
     """The canonical loop (``pytorch_cnn.py:125-146`` shape): epochs × batches,
     per-``log_every``-batch loss/time prints
@@ -117,6 +153,12 @@ def fit(
     gang's replicas diverge. 0 (default) disables the check (it is a
     cross-host sync point).
 
+    ``steps_per_call=K`` dispatches K batches per host→device call via a
+    ``lax.scan``-fused step (``make_multi_step``) — same math, same rng
+    stream, K× fewer dispatches; the win for small/fast models whose step
+    time is comparable to dispatch overhead. Ragged trailing groups (end of
+    epoch) fall back to single steps, so any loader length works.
+
     The input ``state``'s buffers are CONSUMED (the fused step donates them
     for in-place updates); use ``FitResult.state``, never the argument,
     afterwards. Build from copied params if two fits must share an init.
@@ -125,7 +167,10 @@ def fit(
 
     emit = emit or log.info
     rng = rng if rng is not None else jax.random.key(0)
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
     step_fn = make_train_step(loss_fn)
+    multi_fn = make_multi_step(loss_fn) if steps_per_call > 1 else None
     tracer = StepWindowTracer(
         profile_dir, start=profile_window[0], stop=profile_window[1]
     )
@@ -162,7 +207,7 @@ def fit(
             state, history = _run_epochs(
                 state, step_fn, train_loader, epochs, rng, mesh, log_every,
                 emit, tracer, checkpointer, checkpoint_every, span_timer, sink,
-                sync_check_every,
+                sync_check_every, multi_fn, steps_per_call,
             )
         finally:
             # An exception mid-window must still stop the (process-global)
@@ -193,8 +238,12 @@ def fit(
 def _run_epochs(
     state, step_fn, train_loader, epochs, rng, mesh, log_every, emit,
     tracer, checkpointer, checkpoint_every, span_timer, sink=None,
-    sync_check_every=0,
+    sync_check_every=0, multi_fn=None, steps_per_call=1,
 ):
+    from machine_learning_apache_spark_tpu.parallel.mesh import (
+        shard_batch_stack,
+    )
+
     history: list[dict] = []
     global_step = 0
     for epoch in range(epochs):
@@ -203,29 +252,79 @@ def _run_epochs(
         epoch_metrics = MetricBundle()
         # Step outputs stay on-device until a log point — float()ing per step
         # would sync the host into every step and serialize async dispatch.
+        # Entries are (mean_loss, mean_aux, n_steps): n_steps > 1 for a
+        # scanned multi-step dispatch, keeping epoch means weight-exact.
         pending: list[tuple] = []
 
         def _drain():
-            for dev_loss, dev_aux in jax.device_get(pending):
-                epoch_metrics.mean("loss").update(dev_loss)
+            for dev_loss, dev_aux, n in jax.device_get(pending):
+                epoch_metrics.mean("loss").update(dev_loss, n)
                 for k, v in dev_aux.items():
-                    epoch_metrics.mean(k).update(v)
+                    epoch_metrics.mean(k).update(v, n)
             pending.clear()
 
-        for batch in train_loader:
+        def _log_point(prev_step):
+            # Stride-aware: emit when the counter crossed a log_every
+            # boundary this dispatch (multi-step strides can jump past the
+            # exact multiple).
+            return log_every and (
+                global_step // log_every > prev_step // log_every
+            )
+
+        def _emit_log():
+            _drain()
+            emit(
+                f"epoch {epoch} step {global_step} | "
+                f"{epoch_metrics.log_line()} | {span_timer.lap():.3f} sec/{log_every} batches"
+            )
+
+        group: list = []
+
+        def _flush_group():
+            nonlocal state, rng, global_step
+            stacked = (
+                shard_batch_stack(mesh, group)
+                if mesh is not None
+                else jax.tree.map(lambda *xs: jnp.stack(xs), *group)
+            )
+            tracer.on_step(global_step)
+            prev = global_step
+            state, rng, losses, auxes = multi_fn(state, stacked, rng)
+            global_step += len(group)
+            pending.append((
+                losses.mean(),
+                jax.tree.map(lambda v: v.mean(), auxes),
+                len(group),
+            ))
+            group.clear()
+            if _log_point(prev):
+                _emit_log()
+
+        def _single_step(batch):
+            nonlocal state, rng, global_step
             if mesh is not None:
                 batch = shard_batch(mesh, batch)
             rng, step_rng = jax.random.split(rng)
             tracer.on_step(global_step)
             state, loss, aux = step_fn(state, batch, step_rng)
             global_step += 1
-            pending.append((loss, aux))
-            if log_every and global_step % log_every == 0:
-                _drain()
-                emit(
-                    f"epoch {epoch} step {global_step} | "
-                    f"{epoch_metrics.log_line()} | {span_timer.lap():.3f} sec/{log_every} batches"
-                )
+            pending.append((loss, aux, 1))
+            if _log_point(global_step - 1):
+                _emit_log()
+
+        for batch in train_loader:
+            if multi_fn is not None:
+                group.append(batch)
+                if len(group) == steps_per_call:
+                    _flush_group()
+            else:
+                _single_step(batch)
+        # Ragged trailing group: fewer than steps_per_call batches left in
+        # the epoch — run them as single steps (a scan over a shorter stack
+        # would force a recompile per distinct remainder length).
+        for batch in group:
+            _single_step(batch)
+        group.clear()
         _drain()
         computed = epoch_metrics.compute()
         computed["epoch"] = epoch

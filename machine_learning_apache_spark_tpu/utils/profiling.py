@@ -78,13 +78,15 @@ class StepWindowTracer:
         self._active = False
 
     def on_step(self, step: int) -> None:
-        if self.log_dir is None:
+        # Boundary-crossing (>=), not equality: callers may advance the step
+        # counter in strides > 1 (fit's steps_per_call dispatches K steps per
+        # on_step call) and must still enter/leave the window.
+        if self.log_dir is None or step >= self.stop:
+            self.close()
             return
-        if not self._active and step == self.start:
+        if not self._active and step >= self.start:
             jax.profiler.start_trace(self.log_dir)
             self._active = True
-        elif self._active and step >= self.stop:
-            self.close()
 
     def close(self) -> None:
         if self._active:

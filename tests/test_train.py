@@ -162,6 +162,74 @@ class TestFitMLP:
         assert int(result.state.step) == 3
 
 
+class TestStepsPerCall:
+    """``fit(steps_per_call=K)`` — the scanned multi-step trainer
+    (``make_multi_step``: K steps fused into one dispatch via ``lax.scan``,
+    the dispatch-overhead amortization for small/fast models) — must be a
+    pure performance knob: same rng stream, same step order, numerically
+    identical params and identical epoch metrics."""
+
+    def _run(self, k, *, n=60, bs=10, epochs=2, mesh=None, seed=3):
+        data_rng = np.random.default_rng(seed)
+        feats, labels = _synthetic_classification(data_rng, n=n)
+        model = MLP(layers=(4, 5, 4, 3))
+        params = model.init(jax.random.key(0), feats[:1])["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.03)
+        )
+        return fit(
+            state, classification_loss(model.apply),
+            _batches(feats, labels, bs), epochs=epochs, log_every=0,
+            rng=jax.random.key(7), steps_per_call=k, mesh=mesh,
+        )
+
+    def _assert_same(self, r1, rk):
+        for a, b in zip(
+            jax.tree.leaves(r1.state.params), jax.tree.leaves(rk.state.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            )
+        for h1, hk in zip(r1.history, rk.history):
+            np.testing.assert_allclose(h1["loss"], hk["loss"], rtol=1e-5)
+            np.testing.assert_allclose(
+                h1["accuracy"], hk["accuracy"], rtol=1e-5
+            )
+
+    def test_parity_exact_groups(self):
+        # 6 batches/epoch, K=3 → two full groups per epoch, no remainder.
+        self._assert_same(self._run(1), self._run(3))
+
+    def test_parity_ragged_tail(self):
+        # 6 batches/epoch, K=4 → one scanned group + 2 single-step batches:
+        # the ragged fallback must keep the same rng/step sequence.
+        self._assert_same(self._run(1), self._run(4))
+
+    def test_parity_group_larger_than_epoch(self):
+        # K=16 > 6 batches/epoch → every batch takes the single-step path.
+        self._assert_same(self._run(1), self._run(16))
+
+    def test_parity_on_mesh(self):
+        from machine_learning_apache_spark_tpu.parallel import make_mesh
+        from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+
+        mesh = make_mesh({DATA_AXIS: 8})
+        # bs=16 → 8-divisible batches; shard_batch_stack places [K, B, ...]
+        # with dim 1 on the data axis.
+        self._assert_same(
+            self._run(1, n=64, bs=16, mesh=mesh),
+            self._run(2, n=64, bs=16, mesh=mesh),
+        )
+
+    def test_step_counter_counts_real_steps(self):
+        r = self._run(3, n=60, bs=10, epochs=2)  # 12 batches total
+        assert int(r.state.step) == 12
+
+    def test_invalid_steps_per_call(self):
+        with pytest.raises(ValueError, match="steps_per_call"):
+            self._run(0)
+
+
 class TestOptimizerKnobs:
     """Schedules, clipping, accumulation — training-scale knobs the
     reference's fixed-lr SGD/Adam lacks (SURVEY.md §2.3 headroom)."""
