@@ -245,6 +245,39 @@ class MeasurementInvalid(RuntimeError):
     tunnel RPC failures as fatal."""
 
 
+def _with_deadline(fn, seconds: float, label: str):
+    """Run a device workload with a wall-clock deadline.
+
+    The tunnel has two distinct failure modes: RPCs that fail fast (handled
+    by _transient_retry) and RPCs that hang forever — a mid-r04 sweep
+    compile stalled 27+ minutes with the process otherwise healthy. A hung
+    call cannot be cancelled, but it CAN be abandoned: the workload runs in
+    a daemon thread, and on deadline the main thread moves on so the final
+    JSON artifact always prints (a partial artifact beats none — the
+    lesson of BENCH_r01/r03). The wedged thread dies with the process.
+    """
+    import threading
+
+    box: dict = {}
+
+    def run():
+        try:
+            box["result"] = fn()
+        except Exception as e:  # noqa: BLE001 — reported via the artifact
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True, name=f"bench-{label}")
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        log(f"{label} exceeded its {seconds:.0f}s deadline (hung tunnel "
+            f"RPC?) — abandoning the thread and moving on")
+        raise TimeoutError(f"{label} deadline ({seconds:.0f}s) exceeded")
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
 def _transient_retry(fn, label: str, attempts: int = 2):
     """Retry a bench workload once after a transient tunnel RPC failure.
 
@@ -256,7 +289,12 @@ def _transient_retry(fn, label: str, attempts: int = 2):
         try:
             return fn()
         except Exception as e:
-            fatal = attempt == attempts - 1 or isinstance(e, MeasurementInvalid)
+            # TimeoutError is fatal too: the abandoned thread may still be
+            # executing on the device — a retry would interleave two
+            # workloads and report contention-corrupted timings.
+            fatal = attempt == attempts - 1 or isinstance(
+                e, (MeasurementInvalid, TimeoutError)
+            )
             if fatal:
                 raise
             log(f"{label} attempt {attempt + 1} failed transiently: {e!r}; "
@@ -504,7 +542,9 @@ def bench_transformer(
     return out
 
 
-def bench_transformer_sweep(jax) -> list[dict]:
+def bench_transformer_sweep(
+    jax, points: list | None = None, stop_at: float | None = None
+) -> list[dict]:
     """MFU scaling sweep: batch-per-chip {32, 128, 256} × layers {1, 4} on
     the MT workload. The reference config (bs=32, 1 layer, seq 200) is
     latency-bound and undersells the MXU; this locates where the framework
@@ -512,19 +552,41 @@ def bench_transformer_sweep(jax) -> list[dict]:
     nothing about the MXU). Fewer trials than the headline: the goal is an
     MFU-vs-config surface, not the headline number; the paired-window
     protocol inside bench_transformer still applies per point.
+
+    ``points`` may be caller-supplied so completed points survive a
+    deadline abandonment mid-sweep; ``stop_at`` (a ``time.monotonic()``
+    timestamp) makes a healthy-but-slow sweep stop itself between points —
+    the outer thread-abandon deadline is only the backstop for a single
+    wedged call, never the scheduler for a live one (see main()).
     """
-    points = []
+    points = [] if points is None else points
+    point_deadline = float(os.environ.get("BENCH_SWEEP_POINT_DEADLINE", "300"))
+    hung = 0
     for layers in (1, 4):
         for bpc in (32, 128, 256, 512):
             if layers == 4 and bpc == 512:
                 continue  # ~50s/trial window; the surface is clear by then
             if bpc == BATCH_PER_CHIP and layers == LAYERS:
                 continue  # the headline run already measured this point
+            if stop_at is not None and time.monotonic() >= stop_at:
+                log("sweep stopped at its time budget; returning "
+                    f"{len(points)} completed points")
+                return points
+            if hung >= 2:
+                # Two consecutive hung points = the tunnel is wedged, not
+                # one unlucky RPC; stop feeding it deadline budget.
+                log("sweep aborted after 2 consecutive hung points")
+                return points
             try:
-                r = bench_transformer(
-                    jax, batch_per_chip=bpc, layers=layers,
-                    trials=2, steps=10, warmup=5,
+                r = _with_deadline(
+                    lambda: bench_transformer(
+                        jax, batch_per_chip=bpc, layers=layers,
+                        trials=2, steps=10, warmup=5,
+                    ),
+                    point_deadline,
+                    f"sweep bs={bpc} L={layers}",
                 )
+                hung = 0
                 points.append({
                     "batch_per_chip": bpc,
                     "layers": layers,
@@ -540,6 +602,9 @@ def bench_transformer_sweep(jax) -> list[dict]:
                     f"{r['median']:,.0f} tok/s/chip, mfu={r['mfu']}"
                 )
             except Exception as e:
+                # Only *consecutive* timeouts count as a wedged tunnel; a
+                # fast failure in between proves it was responsive.
+                hung = hung + 1 if isinstance(e, TimeoutError) else 0
                 log(f"sweep point bs={bpc} layers={layers} failed: {e!r}")
                 points.append({
                     "batch_per_chip": bpc, "layers": layers, "error": repr(e),
@@ -785,22 +850,48 @@ def main() -> None:
         return
     # The two workloads degrade independently: a transformer failure must
     # not suppress the CNN measurement, and vice versa.
+    deadline = float(os.environ.get("BENCH_WORKLOAD_DEADLINE", "900"))
     try:
-        mt = _transient_retry(lambda: bench_transformer(jax), "transformer")
+        mt = _transient_retry(
+            lambda: _with_deadline(
+                lambda: bench_transformer(jax), deadline, "transformer"
+            ),
+            "transformer",
+        )
         baseline = bench_torch_transformer()
         result["value"] = mt["median"]
         result["vs_baseline"] = round(mt["median"] / baseline, 3) if baseline else 1.0
         result.update(mt)
-        if (
-            jax.devices()[0].platform == "tpu"
-            and not os.environ.get("BENCH_SKIP_SWEEP")
-        ):
-            result["sweep"] = bench_transformer_sweep(jax)
     except Exception as e:
         log(traceback.format_exc())
         result["error"] = repr(e)
+    if (
+        jax.devices()[0].platform == "tpu"
+        and not os.environ.get("BENCH_SKIP_SWEEP")
+    ):
+        # Own try-block, gated on the platform (not the headline result):
+        # neither a headline failure nor a sweep failure may void the other,
+        # and a mid-sweep hang keeps the completed points. The sweep checks
+        # the same deadline between points itself; the thread-abandon
+        # wrapper is only the backstop for one wedged call.
+        sweep_points: list = []
+        try:
+            result["sweep"] = _with_deadline(
+                lambda: bench_transformer_sweep(
+                    jax, sweep_points, stop_at=time.monotonic() + deadline
+                ),
+                deadline + 60, "sweep",
+            )
+        except Exception as e:
+            log(traceback.format_exc())
+            # Snapshot: the abandoned thread could still append mid-dumps.
+            result["sweep"] = list(sweep_points)
+            result["sweep_error"] = repr(e)
     try:
-        cnn = _transient_retry(lambda: bench_cnn(jax), "cnn")
+        cnn = _transient_retry(
+            lambda: _with_deadline(lambda: bench_cnn(jax), deadline, "cnn"),
+            "cnn",
+        )
         cnn_base = bench_torch_cnn()
         cnn["vs_baseline"] = (
             round(cnn["value"] / cnn_base, 3) if cnn_base else 1.0

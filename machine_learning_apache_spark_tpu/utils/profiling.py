@@ -76,15 +76,21 @@ class StepWindowTracer:
         self.log_dir = log_dir
         self.start, self.stop = start, stop
         self._active = False
+        self._done = False
 
     def on_step(self, step: int) -> None:
         # Boundary-crossing (>=), not equality: callers may advance the step
-        # counter in strides > 1 (fit's steps_per_call dispatches K steps per
-        # on_step call) and must still enter/leave the window.
-        if self.log_dir is None or step >= self.stop:
+        # counter in strides > 1 (fit's steps_per_call dispatches K steps
+        # per on_step call) and must still enter/leave the window. Order
+        # matters: the stop check applies only while active, so a single
+        # stride crossing BOTH boundaries still starts a trace (covering at
+        # least its own dispatch; the next call closes it).
+        if self.log_dir is None:
+            return
+        if self._active and step >= self.stop:
             self.close()
             return
-        if not self._active and step >= self.start:
+        if not self._active and not self._done and step >= self.start:
             jax.profiler.start_trace(self.log_dir)
             self._active = True
 
@@ -93,6 +99,7 @@ class StepWindowTracer:
             _sync_local_devices()
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
             log.info(
                 "profiler trace (steps %d-%d) written to %s",
                 self.start, self.stop, self.log_dir,

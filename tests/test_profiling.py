@@ -41,6 +41,28 @@ class TestStepWindowTracer:
         assert not t._active  # closed at step 3
         assert trace_files(d)
 
+    def test_stride_crosses_window(self, tmp_path):
+        # A stride-K caller (fit's steps_per_call) can jump the counter
+        # straight over [start, stop): the tracer must still capture at
+        # least one dispatch, and must not restart after closing.
+        d = str(tmp_path / "stride")
+        t = StepWindowTracer(d, start=2, stop=5)
+        for step in (0, 5, 10, 15):
+            t.on_step(step)
+            jnp.square(jnp.arange(4.0)).block_until_ready()
+        t.close()
+        assert not t._active and t._done
+        assert trace_files(d)
+
+    def test_stride_enters_and_leaves(self, tmp_path):
+        d = str(tmp_path / "stride2")
+        t = StepWindowTracer(d, start=2, stop=5)
+        for step in (0, 4, 8, 12):  # enters at 4, leaves at 8
+            t.on_step(step)
+            jnp.square(jnp.arange(4.0)).block_until_ready()
+        assert not t._active and t._done  # closed at 8, no restart at 12
+        assert trace_files(d)
+
     def test_none_dir_noop(self):
         t = StepWindowTracer(None)
         for step in range(10):
