@@ -74,6 +74,11 @@ class LSTMRecipe:
     # Structured observability: append per-epoch + end-of-run JSON lines
     # (train.metrics.MetricsLogger) alongside the print vocabulary.
     metrics_path: str | None = None
+    # K batches per host dispatch via the scanned trainer
+    # (train.loop.make_multi_step: lax.scan inside one XLA program —
+    # same math/rng stream, K× fewer dispatches). Worth raising for
+    # small/fast models whose step time rivals dispatch overhead.
+    steps_per_call: int = 1
     # Which position feeds the classifier head: "last" is the reference's
     # read of the FINAL column (``pytorch_lstm.py:160`` — on end-padded
     # batches that is the state after up to fixed_len − len(row) pad steps);
@@ -91,6 +96,15 @@ def train_lstm(
 ) -> dict:
     r = with_overrides(recipe or LSTMRecipe(), overrides)
 
+    if r.bucket_by_length and r.steps_per_call > 1:
+        # Same guard as the translation recipe: scanned dispatch stacks K
+        # batches into one static shape; buckets emit per-bucket widths and
+        # would crash np.stack mid-epoch instead of failing loudly here.
+        raise ValueError(
+            "steps_per_call > 1 is incompatible with bucket_by_length: "
+            "scanned dispatch stacks K batches into one static shape, but "
+            "buckets emit per-bucket widths"
+        )
     if r.data_root:
         train_texts, train_labels = load_ag_news(r.data_root, train=True)
         test_texts, test_labels = load_ag_news(r.data_root, train=False)
@@ -182,6 +196,7 @@ def train_lstm(
             checkpointer=ckpt,
             checkpoint_every=r.checkpoint_every,
             metrics_file=r.metrics_path,
+            steps_per_call=r.steps_per_call,
         )
     metrics = evaluate(
         result.state,

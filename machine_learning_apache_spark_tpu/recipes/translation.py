@@ -124,6 +124,10 @@ class TranslationRecipe:
     # sequence_parallel (the ring needs one divisible length).
     bucket_by_length: bool = False
     bucket_boundaries: tuple[int, ...] = ()  # () → (1/4, 1/2, full) of max_len
+    # K batches per host dispatch via the scanned trainer (fixed-width
+    # loaders only: stacked scan batches need one static shape, so this is
+    # incompatible with bucket_by_length's per-bucket widths).
+    steps_per_call: int = 1
 
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
@@ -255,6 +259,12 @@ def train_translator(
         raise ValueError(
             "bucket_by_length is incompatible with sequence_parallel: the "
             "ring needs one fixed seq-axis-divisible length"
+        )
+    if r.bucket_by_length and r.steps_per_call > 1:
+        raise ValueError(
+            "steps_per_call > 1 is incompatible with bucket_by_length: "
+            "scanned dispatch stacks K batches into one static shape, but "
+            "buckets emit per-bucket widths"
         )
     if r.pipeline_parallel > 1:
         # The pipeline schedule supports dp×pp meshes only (TP/SP inside a
@@ -415,6 +425,7 @@ def train_translator(
                 checkpoint_every=r.checkpoint_every,
                 metrics_file=r.metrics_path,
                 zero1=r.zero1,
+                steps_per_call=r.steps_per_call,
             )
             metrics = evaluate(
                 result.state,

@@ -92,6 +92,16 @@ class TestLSTMRecipe:
         assert 0.3 < out["padding_efficiency"] < 1.0
         assert out["eval_samples"] == 128  # eval path unchanged, full coverage
 
+    def test_bucketed_incompatible_with_steps_per_call(self):
+        # Loud up-front error (both lstm and translation): scanned dispatch
+        # stacks K batches into one static shape; buckets emit per-bucket
+        # widths that would crash np.stack mid-epoch otherwise.
+        with pytest.raises(ValueError, match="steps_per_call"):
+            train_lstm(
+                epochs=1, synthetic_n=64, bucket_by_length=True,
+                steps_per_call=2,
+            )
+
     def test_bucketed_zero_batch_config_raises(self):
         with pytest.raises(ValueError, match="length bucket"):
             train_lstm(
