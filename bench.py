@@ -470,8 +470,11 @@ def bench_transformer(
 
     if scan_k > 1:
         # The scanned product path (train.loop.make_multi_step /
-        # fit(steps_per_call=K)): K steps per dispatch, batch rotation
-        # preserved inside the stack.
+        # fit(steps_per_call=K)): K steps per dispatch. The distinct
+        # batches rotate INSIDE the stack (cycled to length K); across
+        # dispatches the same stack is replayed — unlike the per-step
+        # path's endless rotation, but each step in a window still sees
+        # the same input variety.
         import numpy as np
         from machine_learning_apache_spark_tpu.parallel import (
             shard_batch_stack,
@@ -484,9 +487,12 @@ def bench_transformer(
             return loss_fn(params, b[0], b[1], rng), {}
 
         multi = make_multi_step(scan_loss)
-        host = [(np.asarray(s), np.asarray(t)) for s, t in batches]
+        host = [
+            (np.asarray(s), np.asarray(t))
+            for s, t in batches[: min(n_batches, scan_k)]
+        ]
         stacked = shard_batch_stack(
-            mesh, [host[i % n_batches] for i in range(scan_k)]
+            mesh, [host[i % len(host)] for i in range(scan_k)]
         )
 
         def one_step():
@@ -921,11 +927,14 @@ def main() -> None:
         # the per-dispatch host cost the paired-window estimator can only
         # model. Reported alongside (not replacing) the per-step headline.
         try:
-            sc = _with_deadline(
-                lambda: bench_transformer(
-                    jax, scan_k=8, trials=5, steps=10, warmup=20
+            sc = _transient_retry(
+                lambda: _with_deadline(
+                    lambda: bench_transformer(
+                        jax, scan_k=8, trials=5, steps=10, warmup=20
+                    ),
+                    deadline, "transformer-scanned",
                 ),
-                deadline, "transformer-scanned",
+                "transformer-scanned",
             )
             result["scanned"] = {
                 k: sc[k]

@@ -16,6 +16,7 @@ from machine_learning_apache_spark_tpu.parallel.mesh import (
     make_mesh,
     replicate,
     replicated_sharding,
+    device_prefetch,
     shard_batch,
     shard_batch_stack,
 )
@@ -58,6 +59,7 @@ __all__ = [
     "make_mesh",
     "replicate",
     "replicated_sharding",
+    "device_prefetch",
     "shard_batch",
     "shard_batch_stack",
     "assert_replicas_in_sync",

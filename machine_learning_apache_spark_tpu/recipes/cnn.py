@@ -74,6 +74,10 @@ class CNNRecipe:
     # throughput lever for this model class: TinyVGG's step is sub-ms on a
     # TPU, so per-step dispatch caps utilization (see bench.py bench_cnn).
     steps_per_call: int = 1
+    # Shard batches onto the mesh N ahead of consumption
+    # (parallel.device_prefetch): host->device transfers overlap device
+    # compute. Identical values (pinned by TestDevicePrefetch); 0 disables.
+    prefetch_to_device: int = 2
 
 
 def train_cnn(
@@ -140,6 +144,7 @@ def train_cnn(
             checkpoint_every=r.checkpoint_every,
             metrics_file=r.metrics_path,
             steps_per_call=r.steps_per_call,
+            prefetch_to_device=r.prefetch_to_device,
         )
     metrics = evaluate(
         result.state,

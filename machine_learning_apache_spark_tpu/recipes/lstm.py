@@ -79,6 +79,10 @@ class LSTMRecipe:
     # same math/rng stream, K× fewer dispatches). Worth raising for
     # small/fast models whose step time rivals dispatch overhead.
     steps_per_call: int = 1
+    # Shard batches onto the mesh N ahead of consumption
+    # (parallel.device_prefetch): host->device transfers overlap device
+    # compute. Identical values (pinned by TestDevicePrefetch); 0 disables.
+    prefetch_to_device: int = 2
     # Which position feeds the classifier head: "last" is the reference's
     # read of the FINAL column (``pytorch_lstm.py:160`` — on end-padded
     # batches that is the state after up to fixed_len − len(row) pad steps);
@@ -197,6 +201,7 @@ def train_lstm(
             checkpoint_every=r.checkpoint_every,
             metrics_file=r.metrics_path,
             steps_per_call=r.steps_per_call,
+            prefetch_to_device=r.prefetch_to_device,
         )
     metrics = evaluate(
         result.state,

@@ -63,6 +63,10 @@ class MLPRecipe:
     # same math/rng stream, K× fewer dispatches). Worth raising for
     # small/fast models whose step time rivals dispatch overhead.
     steps_per_call: int = 1
+    # Shard batches onto the mesh N ahead of consumption
+    # (parallel.device_prefetch): host->device transfers overlap device
+    # compute. Identical values (pinned by TestDevicePrefetch); 0 disables.
+    prefetch_to_device: int = 2
 
 
 def train_mlp(
@@ -118,6 +122,7 @@ def train_mlp(
             checkpoint_every=r.checkpoint_every,
             metrics_file=r.metrics_path,
             steps_per_call=r.steps_per_call,
+            prefetch_to_device=r.prefetch_to_device,
         )
     metrics = evaluate(
         result.state,

@@ -128,6 +128,10 @@ class TranslationRecipe:
     # loaders only: stacked scan batches need one static shape, so this is
     # incompatible with bucket_by_length's per-bucket widths).
     steps_per_call: int = 1
+    # Shard batches onto the mesh N ahead of consumption
+    # (parallel.device_prefetch): host->device transfers overlap device
+    # compute. Identical values (pinned by TestDevicePrefetch); 0 disables.
+    prefetch_to_device: int = 2
 
 
 def make_translation_loss(model, pad_id: int, *, train: bool = True):
@@ -426,6 +430,7 @@ def train_translator(
                 metrics_file=r.metrics_path,
                 zero1=r.zero1,
                 steps_per_call=r.steps_per_call,
+                prefetch_to_device=r.prefetch_to_device,
             )
             metrics = evaluate(
                 result.state,

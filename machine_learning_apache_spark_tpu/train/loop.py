@@ -124,6 +124,7 @@ def fit(
     sync_check_every: int = 0,
     zero1: bool = False,
     steps_per_call: int = 1,
+    prefetch_to_device: int = 0,
 ) -> FitResult:
     """The canonical loop (``pytorch_cnn.py:125-146`` shape): epochs × batches,
     per-``log_every``-batch loss/time prints
@@ -158,6 +159,12 @@ def fit(
     stream, K× fewer dispatches; the win for small/fast models whose step
     time is comparable to dispatch overhead. Ragged trailing groups (end of
     epoch) fall back to single steps, so any loader length works.
+
+    ``prefetch_to_device=N`` (with a mesh, single-step path) shards batches
+    onto the mesh N ahead of consumption (``parallel.device_prefetch``):
+    host→device transfers overlap device compute instead of serializing in
+    front of each dispatch. Combine with the loader's host-side
+    ``prefetch`` for a fully double-buffered input pipeline.
 
     The input ``state``'s buffers are CONSUMED (the fused step donates them
     for in-place updates); use ``FitResult.state``, never the argument,
@@ -208,6 +215,7 @@ def fit(
                 state, step_fn, train_loader, epochs, rng, mesh, log_every,
                 emit, tracer, checkpointer, checkpoint_every, span_timer, sink,
                 sync_check_every, multi_fn, steps_per_call,
+                prefetch_to_device,
             )
         finally:
             # An exception mid-window must still stop the (process-global)
@@ -239,9 +247,19 @@ def _run_epochs(
     state, step_fn, train_loader, epochs, rng, mesh, log_every, emit,
     tracer, checkpointer, checkpoint_every, span_timer, sink=None,
     sync_check_every=0, multi_fn=None, steps_per_call=1,
+    prefetch_to_device=0,
 ):
     from machine_learning_apache_spark_tpu.parallel.mesh import (
+        device_prefetch,
         shard_batch_stack,
+    )
+
+    # Device prefetch applies to the single-step path: sharded transfers
+    # are issued N batches ahead so they overlap compute. The scanned path
+    # stacks its own groups (and one dispatch already buys K step-times of
+    # host slack), so it keeps raw batches.
+    use_prefetch = (
+        prefetch_to_device > 0 and mesh is not None and multi_fn is None
     )
 
     history: list[dict] = []
@@ -300,9 +318,9 @@ def _run_epochs(
             if _log_point(prev):
                 _emit_log()
 
-        def _single_step(batch):
+        def _single_step(batch, presharded=False):
             nonlocal state, rng, global_step
-            if mesh is not None:
+            if mesh is not None and not presharded:
                 batch = shard_batch(mesh, batch)
             rng, step_rng = jax.random.split(rng)
             tracer.on_step(global_step)
@@ -312,13 +330,18 @@ def _run_epochs(
             if _log_point(global_step - 1):
                 _emit_log()
 
-        for batch in train_loader:
+        epoch_iter = (
+            device_prefetch(train_loader, mesh, depth=prefetch_to_device)
+            if use_prefetch
+            else train_loader
+        )
+        for batch in epoch_iter:
             if multi_fn is not None:
                 group.append(batch)
                 if len(group) == steps_per_call:
                     _flush_group()
             else:
-                _single_step(batch)
+                _single_step(batch, presharded=use_prefetch)
         # Ragged trailing group: fewer than steps_per_call batches left in
         # the epoch — run them as single steps (a scan over a shorter stack
         # would force a recompile per distinct remainder length).
