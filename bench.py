@@ -126,6 +126,7 @@ def _init_backend():
     """
     import jax
 
+    _enable_compile_cache()
     if os.environ.get("BENCH_PLATFORM"):  # e.g. "cpu" for smoke runs
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     else:
@@ -154,6 +155,25 @@ def _init_backend():
             pass
         jax.devices()
     return jax
+
+
+def _enable_compile_cache() -> None:
+    """Persist XLA compiles across bench processes (BENCH_COMPILE_CACHE=0
+    disables; BENCH_COMPILE_CACHE=<dir> relocates). Tunneled compiles cost
+    20-60s per program — a warm cache turns a rerun's warmup into seconds."""
+    val = os.environ.get("BENCH_COMPILE_CACHE", "")
+    if val == "0":
+        return
+    try:
+        from machine_learning_apache_spark_tpu.utils.compilation_cache import (
+            enable_compilation_cache,
+        )
+
+        enable_compilation_cache(
+            val or os.path.join(os.path.dirname(__file__), ".xla_cache")
+        )
+    except Exception as e:  # cache is an accelerant, never a dependency
+        log(f"compilation cache unavailable: {e!r}")
 
 
 def _peak_flops(device) -> float | None:

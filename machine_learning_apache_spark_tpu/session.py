@@ -88,6 +88,12 @@ class Session:
 
     def __init__(self, conf: SessionConfig | None = None) -> None:
         self.conf = conf or SessionConfig()
+        if self.conf.compilation_cache_dir:
+            from machine_learning_apache_spark_tpu.utils.compilation_cache import (
+                enable_compilation_cache,
+            )
+
+            enable_compilation_cache(self.conf.compilation_cache_dir)
         if self.conf.platform:
             # Respect an explicit platform request (e.g. tests force "cpu").
             # Env vars are unreliable here — jax may already be imported — so

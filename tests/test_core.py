@@ -52,6 +52,39 @@ class TestSession:
         assert s.device_count == 8
         s.stop()
 
+    def test_compilation_cache_conf(self, tmp_path):
+        """spark.mlspark.compilationCacheDir-style conf: the session enables
+        the persistent XLA cache, and a compiled program actually writes
+        entries under the dir (reused by later processes — the startup
+        lever for repeat runs on remote-controller topologies)."""
+        import os
+
+        d = str(tmp_path / "xla-cache")
+        s = (
+            mlspark.Session.builder.appName("cache-test")
+            .config("spark.compilation.cache.dir", d)
+            .getOrCreate()
+        )
+        try:
+            assert s.conf.compilation_cache_dir == d
+            assert os.path.isdir(d)
+            # Force min-compile-time to 0 so this tiny program qualifies.
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.jit(lambda x: (x @ x.T).sum())(
+                jnp.ones((64, 64))
+            ).block_until_ready()
+            entries = [f for _, _, fs in os.walk(d) for f in fs]
+            assert entries, "no persistent cache entries written"
+        finally:
+            # Cache settings are process-global JAX config: restore ALL of
+            # them or later tests silently run different cache semantics.
+            from machine_learning_apache_spark_tpu.utils.compilation_cache import (
+                disable_compilation_cache,
+            )
+
+            disable_compilation_cache()
+            s.stop()
+
     def test_stop_clears_singleton(self):
         s = mlspark.Session.builder.get_or_create()
         s.stop()
