@@ -1,0 +1,67 @@
+"""High-throughput CNN training — the round-4 dispatch-pipeline levers.
+
+The reference's CNN loop (``pytorch_cnn.py:125-146``) dispatches one batch
+at a time; on an accelerator whose step outruns the host, that loop — not
+the chip — is the ceiling. This entry point turns on the three levers the
+framework adds (measured on one TPU v5 lite, see PARITY.md):
+
+- ``steps_per_call=K``  — K steps fused into one dispatch (``lax.scan``);
+  1.07M samples/s/chip vs ~220K dispatch-bound on the same workload.
+- ``prefetch_to_device`` — sharded batches staged ahead of consumption so
+  input transfers overlap compute.
+- ``spark.compilation.cache.dir`` — persistent XLA compile cache: reruns
+  deserialize instead of recompiling (20-60s/program on remote chips).
+
+The knob targets accelerators: on the CPU backend there is no dispatch
+bottleneck to remove and XLA:CPU executes a scanned SPMD step markedly
+slower than the per-step program — expect a slowdown there, a speedup on
+TPU (the platform line in the output says which one you measured).
+
+Usage: python examples/high_throughput_cnn.py [steps_per_call] [data_root]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from machine_learning_apache_spark_tpu import Session
+from machine_learning_apache_spark_tpu.recipes.cnn import train_cnn
+
+steps_per_call = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+data_root = sys.argv[2] if len(sys.argv) > 2 else None
+
+spark = (
+    Session.builder.appName("HighThroughputCNN")
+    .config("spark.compilation.cache.dir", os.path.expanduser("~/.mlspark-xla-cache"))
+    .getOrCreate()
+)
+
+import jax
+
+print(f"backend: {jax.devices()[0].platform} × {jax.device_count()}")
+common = dict(
+    epochs=3,
+    batch_size=64,
+    synthetic_n=8192,
+    data_root=data_root,
+    prefetch_to_device=2,
+)
+
+t0 = time.time()
+base = train_cnn(**common, steps_per_call=1)
+t_base = time.time() - t0
+
+t0 = time.time()
+fast = train_cnn(**common, steps_per_call=steps_per_call)
+t_fast = time.time() - t0
+
+print(f"single-step dispatch : {t_base:.2f}s train wall  "
+      f"(final loss {base['final_loss']:.4f})")
+print(f"steps_per_call={steps_per_call:<4d}: {t_fast:.2f}s train wall  "
+      f"(final loss {fast['final_loss']:.4f})")
+print(f"speedup: {t_base / t_fast:.2f}x  |  accuracy "
+      f"{base['accuracy']:.2f} == {fast['accuracy']:.2f} "
+      f"(same rng stream and step order: the knob is pure pipelining)")
+spark.stop()

@@ -19,6 +19,9 @@ from typing import Any, Optional
 import jax
 
 from machine_learning_apache_spark_tpu.config import SessionConfig, _coerce
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
 
 _ACTIVE_SESSION: Optional["Session"] = None
 _LOCK = threading.Lock()
@@ -55,6 +58,16 @@ class SessionBuilder:
     def get_or_create(self) -> "Session":
         global _ACTIVE_SESSION
         with _LOCK:
+            if _ACTIVE_SESSION is not None and self._conf:
+                # Spark semantics: getOrCreate() returns the existing
+                # session and conf on the builder is NOT applied. Silent
+                # drops are expensive (e.g. a compilation_cache_dir that
+                # never enables costs its full compile time) — say so.
+                log.warning(
+                    "getOrCreate(): active session exists; builder conf %s "
+                    "ignored (stop() the session first to apply it)",
+                    sorted(self._conf),
+                )
             if _ACTIVE_SESSION is None:
                 fields = {f.name: f for f in dataclasses.fields(SessionConfig)}
                 kwargs = {}

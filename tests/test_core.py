@@ -53,8 +53,8 @@ class TestSession:
         s.stop()
 
     def test_compilation_cache_conf(self, tmp_path):
-        """spark.mlspark.compilationCacheDir-style conf: the session enables
-        the persistent XLA cache, and a compiled program actually writes
+        """``spark.compilation.cache.dir`` conf: the session enables the
+        persistent XLA cache, and a compiled program actually writes
         entries under the dir (reused by later processes — the startup
         lever for repeat runs on remote-controller topologies)."""
         import os
