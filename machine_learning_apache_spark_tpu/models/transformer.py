@@ -106,6 +106,7 @@ class SentenceEmbedding(nn.Module):
         *,
         deterministic: bool = True,
         position_offset: jnp.ndarray | int = 0,
+        positions: jnp.ndarray | None = None,
     ):
         x = nn.Embed(
             self.vocab_size,
@@ -125,9 +126,15 @@ class SentenceEmbedding(nn.Module):
             self.cfg.d_model,
             self.cfg.dtype,
         )
-        pe = jax.lax.dynamic_slice_in_dim(
-            table, position_offset, tokens.shape[-1], axis=0
-        )
+        if positions is not None:
+            # Per-token position ids ([B, S] gather): sequence packing gives
+            # each packed segment PE rows restarting at 0, so a segment sees
+            # exactly the encoding its pair would see unpacked.
+            pe = table[positions]
+        else:
+            pe = jax.lax.dynamic_slice_in_dim(
+                table, position_offset, tokens.shape[-1], axis=0
+            )
         x = x + pe
         return nn.Dropout(self.cfg.dropout, deterministic=deterministic)(x)
 
@@ -336,9 +343,10 @@ class Encoder(nn.Module):
         src_valid=None,
         *,
         deterministic: bool = True,
+        positions=None,
     ):
         x = SentenceEmbedding(self.cfg.src_vocab_size, self.cfg, name="embed")(
-            src_tokens, deterministic=deterministic
+            src_tokens, deterministic=deterministic, positions=positions
         )
         # MoE pad exclusion must not depend on the attention-mask override:
         # derive token validity from the tokens themselves.
@@ -432,12 +440,14 @@ class Decoder(nn.Module):
         self_causal: bool = False,
         decode: bool = False,
         position_offset: jnp.ndarray | int = 0,
+        positions=None,
         deterministic: bool = True,
     ):
         y = SentenceEmbedding(self.cfg.trg_vocab_size, self.cfg, name="embed")(
             trg_tokens,
             deterministic=deterministic,
             position_offset=position_offset,
+            positions=positions,
         )
         # MoE pad exclusion, independent of any attention-mask override.
         token_valid = (
@@ -507,13 +517,17 @@ class Transformer(nn.Module):
         trg_mask: jnp.ndarray | None = None,
         cross_mask: jnp.ndarray | None = None,
         *,
+        src_positions: jnp.ndarray | None = None,
+        trg_positions: jnp.ndarray | None = None,
         deterministic: bool = True,
     ) -> jnp.ndarray:
         pad = self.cfg.pad_id
         # Default masks stay *structured* — per-key validity vectors plus a
         # causal flag — so TPU runs stream them through the flash kernel
         # without materializing [B, Sq, Sk] (an explicit dense mask override
-        # still takes the fused-XLA path).
+        # still takes the fused-XLA path). Sequence packing
+        # (``data.packing``) overrides all three masks with block-diagonal
+        # segment masks and supplies per-token ``*_positions``.
         src_valid = (src_tokens != pad) if src_mask is None else None
         trg_valid = (trg_tokens != pad) if trg_mask is None else None
         # Cross-attention defaults to masking padded *source* keys whenever
@@ -521,7 +535,8 @@ class Transformer(nn.Module):
         # src_mask was overridden (each attention site keeps its own default).
         memory_valid = (src_tokens != pad) if cross_mask is None else None
         memory = self.encoder(
-            src_tokens, src_mask, src_valid, deterministic=deterministic
+            src_tokens, src_mask, src_valid, deterministic=deterministic,
+            positions=src_positions,
         )
         y = self.decoder(
             trg_tokens,
@@ -531,6 +546,7 @@ class Transformer(nn.Module):
             trg_valid,
             memory_valid,
             self_causal=trg_mask is None,
+            positions=trg_positions,
             deterministic=deterministic,
         )
         return self._logits(y)

@@ -53,6 +53,21 @@ def make_attention_mask(
     return (query_valid[:, None, :, None] & key_valid[:, None, None, :])
 
 
+def make_segment_mask(
+    query_segments: jnp.ndarray, key_segments: jnp.ndarray
+) -> jnp.ndarray:
+    """``[B, 1, Sq, Sk]`` block-diagonal mask from per-token segment ids.
+
+    A query may attend only keys of the SAME nonzero segment — the packing
+    mask (``data.packing``): multiple sequences share one row without
+    attending across each other, and segment id 0 (padding) attends/is
+    attended by nothing.
+    """
+    q = query_segments[:, None, :, None]
+    k = key_segments[:, None, None, :]
+    return (q == k) & (q > 0) & (k > 0)
+
+
 def combine_masks(*masks: jnp.ndarray | None) -> jnp.ndarray | None:
     """AND together broadcastable masks, skipping Nones (e.g. causal ∧ padding)."""
     present = [m for m in masks if m is not None]

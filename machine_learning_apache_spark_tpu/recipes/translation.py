@@ -124,6 +124,21 @@ class TranslationRecipe:
     # sequence_parallel (the ring needs one divisible length).
     bucket_by_length: bool = False
     bucket_boundaries: tuple[int, ...] = ()  # () → (1/4, 1/2, full) of max_len
+    # Sequence packing (data.packing): fill each fixed max_len row with
+    # SEVERAL sentence pairs behind block-diagonal segment masks +
+    # per-segment positional restart — one static shape, near-zero pad
+    # work. Per-pair numerics match the unpacked run (tests/test_packing).
+    # Training only; eval keeps one pair per row. Incompatible with
+    # bucket_by_length (different answer to the same waste), SP (the ring
+    # classifies chunks globally, not per segment), PP (microbatch split
+    # needs the plain loss), and MoE (capacity routing untested on mixed
+    # rows — rejected loudly rather than silently unvalidated).
+    # Trade-off: segment masks are dense [B,1,S,S] overrides, so packed
+    # attention takes the fused-XLA path, not the Pallas flash kernel —
+    # immaterial at this workload's seq 200 (40K scores/head), and the
+    # packing win is in the matmuls; a flash-consumable segment spec is
+    # the kernel-side follow-up if long-context packing is ever needed.
+    pack_sequences: bool = False
     # K batches per host dispatch via the scanned trainer (fixed-width
     # loaders only: stacked scan batches need one static shape, so this is
     # incompatible with bucket_by_length's per-bucket widths).
@@ -161,6 +176,61 @@ def make_translation_loss(model, pad_id: int, *, train: bool = True):
             return loss + model.cfg.moe_aux_weight * aux, {"moe_aux": aux}
         logits = model.apply({"params": params}, src, trg[:, :-1], **kwargs)
         loss = masked_token_cross_entropy(logits, trg[:, 1:], pad_id)
+        return loss, {}
+
+    return loss_fn
+
+
+def make_packed_translation_loss(model, pad_id: int, *, train: bool = True):
+    """Teacher-forced CE over PACKED batches
+    (``src, src_seg, src_pos, trg, trg_seg, trg_pos`` — ``data.packing``).
+
+    Same per-token CE as ``make_translation_loss`` on the equivalent
+    unpacked rows (pinned by ``tests/test_packing.py`` logit/loss parity):
+    block-diagonal segment masks at all three attention sites, per-segment
+    positional restart, and a loss mask that additionally drops the
+    boundary position where one segment's last token would otherwise be
+    scored against the NEXT segment's first.
+    """
+    import optax
+
+    from machine_learning_apache_spark_tpu.ops.masks import (
+        combine_masks,
+        make_causal_mask,
+        make_segment_mask,
+    )
+
+    def loss_fn(params, batch, rng):
+        src, src_seg, src_pos, trg, trg_seg, trg_pos = batch
+        tin_seg = trg_seg[:, :-1]
+        logits = model.apply(
+            {"params": params},
+            src,
+            trg[:, :-1],
+            src_mask=make_segment_mask(src_seg, src_seg),
+            trg_mask=combine_masks(
+                make_segment_mask(tin_seg, tin_seg),
+                make_causal_mask(tin_seg.shape[1]),
+            ),
+            cross_mask=make_segment_mask(tin_seg, src_seg),
+            src_positions=src_pos,
+            trg_positions=trg_pos[:, :-1],
+            deterministic=not train,
+            rngs={"dropout": rng} if train else None,
+        )
+        labels = trg[:, 1:]
+        # Score a position only when its label belongs to the SAME segment
+        # as its input token: pad labels drop (segment 0) and so does each
+        # segment's boundary into the next. The pad_id conjunct is
+        # redundant under the packer's segment-0-iff-pad convention; it
+        # keeps the signature's pad contract honest if that ever diverges.
+        scored = (
+            (trg_seg[:, 1:] == tin_seg) & (tin_seg > 0) & (labels != pad_id)
+        )
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        )
+        loss = (per_tok * scored).sum() / jnp.maximum(scored.sum(), 1)
         return loss, {}
 
     return loss_fn
@@ -219,7 +289,23 @@ def train_translator(
         src_pipe([s for s, _ in ps]),
         trg_pipe([t for _, t in ps]),
     )
-    train_ds = ArrayDataset(*to_ids(pairs))
+    packed = None
+    if r.pack_sequences:
+        from machine_learning_apache_spark_tpu.data.packing import (
+            pack_translation_pairs,
+        )
+        from machine_learning_apache_spark_tpu.data.text import PAD_ID
+
+        packed = pack_translation_pairs(
+            src_pipe.ragged([s for s, _ in pairs]),
+            trg_pipe.ragged([t for _, t in pairs]),
+            src_len=r.max_len,
+            trg_len=r.max_len,
+            pad_id=PAD_ID,
+        )
+        train_ds = ArrayDataset(*packed.arrays())
+    else:
+        train_ds = ArrayDataset(*to_ids(pairs))
     val_ds = ArrayDataset(*to_ids(val_pairs))
 
     cfg = TransformerConfig(
@@ -270,6 +356,19 @@ def train_translator(
             "scanned dispatch stacks K batches into one static shape, but "
             "buckets emit per-bucket widths"
         )
+    if r.pack_sequences:
+        blockers = {
+            "bucket_by_length": r.bucket_by_length,
+            "sequence_parallel": r.sequence_parallel > 1,
+            "pipeline_parallel": r.pipeline_parallel > 1,
+            "moe_experts": r.moe_experts > 0,
+        }
+        bad = [k for k, v in blockers.items() if v]
+        if bad:
+            raise ValueError(
+                f"pack_sequences is incompatible with {bad} (see the "
+                f"recipe field's rationale)"
+            )
     if r.pipeline_parallel > 1:
         # The pipeline schedule supports dp×pp meshes only (TP/SP inside a
         # stage and MoE capacity routing are out of scope for the ring).
@@ -330,7 +429,10 @@ def train_translator(
             seed=r.seed,
         )
 
-    src0, trg0 = train_ds[:2]
+    if r.pack_sequences:
+        src0, trg0 = train_ds[:2][0], train_ds[:2][3]
+    else:
+        src0, trg0 = train_ds[:2]
     params = model.init(jax.random.key(r.seed), src0, trg0[:, :-1])["params"]
     # total_steps counts OPTIMIZER updates: under accumulation only every
     # grad_accum-th microbatch updates, and MultiSteps' microbatch counter
@@ -409,13 +511,14 @@ def train_translator(
                     accumulate_steps=r.grad_accum,
                 )
             )
-        train_loss = (
-            make_pipeline_translation_loss(
+        if r.pipeline_parallel > 1:
+            train_loss = make_pipeline_translation_loss(
                 model, cfg.pad_id, mesh, n_micro=r.pipeline_microbatches
             )
-            if r.pipeline_parallel > 1
-            else make_translation_loss(model, cfg.pad_id)
-        )
+        elif r.pack_sequences:
+            train_loss = make_packed_translation_loss(model, cfg.pad_id)
+        else:
+            train_loss = make_translation_loss(model, cfg.pad_id)
         with sp_ctx:
             result = fit(
                 state,
@@ -443,6 +546,15 @@ def train_translator(
         extra["resumed_from_step"] = resumed
     if r.bucket_by_length:
         extra["padding_efficiency"] = train_loader.padding_efficiency
+    if packed is not None:
+        # Non-pad fraction of the packed token grid, vs what the same
+        # corpus costs one-pair-per-row (the reference's layout).
+        extra["packing_token_efficiency"] = round(packed.token_efficiency, 4)
+        extra["unpacked_token_efficiency"] = round(
+            packed.unpacked_efficiency, 4
+        )
+        extra["packed_rows"] = len(packed.src)
+        extra["packed_pairs"] = packed.pair_count
     if r.compute_bleu and val_loader is not None:
         from machine_learning_apache_spark_tpu.data.text import EOS_ID, SOS_ID
         from machine_learning_apache_spark_tpu.models.transformer import (
