@@ -609,6 +609,130 @@ def bench_transformer(
     return out
 
 
+def bench_packed_transformer(
+    jax, *, trials: int = 3, steps: int = 10, warmup: int = 10
+) -> dict:
+    """Effective-throughput measurement of sequence packing on the MT
+    workload (``pack_sequences=True``): synthetic ragged pairs with a
+    Multi30k-like length distribution (mean ~15 tokens vs the fixed
+    200-token rows of ``pytorch_machine_translator.py:70-98``), packed by
+    ``data.packing`` and trained with the packed loss. The headline metric
+    is PAIRS/sec/chip — the work a user actually cares about — which the
+    fixed-width layout caps at (token rate)/200 regardless of how short
+    the sentences are.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from machine_learning_apache_spark_tpu.data.packing import (
+        pack_translation_pairs,
+    )
+    from machine_learning_apache_spark_tpu.models import (
+        Transformer,
+        TransformerConfig,
+    )
+    from machine_learning_apache_spark_tpu.parallel import DATA_AXIS, make_mesh
+    from machine_learning_apache_spark_tpu.recipes.translation import (
+        make_packed_translation_loss,
+    )
+    from machine_learning_apache_spark_tpu.train.state import (
+        TrainState,
+        make_optimizer,
+    )
+
+    n_chips = jax.device_count()
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    batch = BATCH_PER_CHIP * n_chips
+    rng = np.random.default_rng(0)
+
+    # Multi30k-shaped ragged corpus: clipped-normal lengths, mean ~15.
+    def ragged(n, vocab, mean=15.0):
+        lens = np.clip(rng.normal(mean, 5.0, n), 4, 60).astype(int)
+        return [list(rng.integers(4, vocab, l)) for l in lens]
+
+    n_pairs = 4096
+    packed = pack_translation_pairs(
+        ragged(n_pairs, SRC_VOCAB), ragged(n_pairs, TRG_VOCAB, 17.0),
+        src_len=SEQ, trg_len=SEQ,
+    )
+    rows = len(packed.src)
+    pairs_per_row = packed.pair_count / rows
+
+    cfg = TransformerConfig(
+        src_vocab_size=SRC_VOCAB,
+        trg_vocab_size=TRG_VOCAB,
+        max_len=SEQ,
+        num_layers=LAYERS,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = Transformer(cfg)
+    mesh = make_mesh({DATA_AXIS: n_chips})
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    n_batches = 4
+    batches = []
+    for i in range(n_batches):
+        idx = (np.arange(batch) + i * batch) % rows
+        batches.append(tuple(
+            jax.device_put(jnp.asarray(a[idx]), sharding)
+            for a in packed.arrays()
+        ))
+
+    params = model.init(
+        jax.random.key(1), batches[0][0][:2], batches[0][3][:2, :-1]
+    )["params"]
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_optimizer("adam", 1e-3)
+    )
+    loss_fn = make_packed_translation_loss(model, cfg.pad_id)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(state, b, rng):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, b, rng
+        )
+        return state.apply_gradients(grads), loss
+
+    holder = {"state": state, "rng": jax.random.key(2), "i": 0}
+
+    def one_step():
+        holder["rng"], sub = jax.random.split(holder["rng"])
+        b = batches[holder["i"] % n_batches]
+        holder["i"] += 1
+        holder["state"], holder["loss"] = step(holder["state"], b, sub)
+
+    for _ in range(warmup):
+        one_step()
+    _value_barrier(holder)
+    log(f"packed warmup done ({pairs_per_row:.1f} pairs/row, "
+        f"grid use {packed.token_efficiency:.1%})")
+
+    barrier = lambda: _value_barrier(holder)  # noqa: E731
+    if on_tpu and LONG_WINDOW > 1:
+        # Long windows only: this bench reports one rate (no paired-window
+        # diagnostic), so a short-window pass would be discarded work.
+        steps = steps * LONG_WINDOW
+    times = _time_trials(one_step, trials, steps, barrier)
+    pairs_rate = sorted(
+        batch * pairs_per_row * steps / dt / n_chips for dt in times
+    )
+    median = statistics.median(pairs_rate)
+    for dt in times:
+        log(f"packed: {steps} steps in {dt:.3f}s → "
+            f"{batch * pairs_per_row * steps / dt / n_chips:,.0f} pairs/sec/chip")
+    return {
+        "pairs_per_sec_chip": round(median, 1),
+        "max": round(pairs_rate[-1], 1),
+        "spread": round(pairs_rate[-1] / pairs_rate[0], 2),
+        "pairs_per_row": round(pairs_per_row, 2),
+        "token_efficiency": round(packed.token_efficiency, 4),
+        "unpacked_token_efficiency": round(packed.unpacked_efficiency, 4),
+        "loss": round(float(holder["loss"]), 3),
+    }
+
+
 def bench_transformer_sweep(
     jax, points: list | None = None, stop_at: float | None = None
 ) -> list[dict]:
@@ -967,6 +1091,29 @@ def main() -> None:
         except Exception as e:
             log(traceback.format_exc())
             result["scanned"] = {"error": repr(e)}
+            suspect = suspect or isinstance(e, TimeoutError)
+    if (
+        jax.devices()[0].platform == "tpu"
+        and not suspect
+        and not os.environ.get("BENCH_SKIP_PACKED")
+    ):
+        # Sequence packing on the same workload: pairs/sec/chip against the
+        # fixed-width layout's (token rate)/SEQ ceiling.
+        try:
+            pk = _transient_retry(
+                lambda: _with_deadline(
+                    lambda: bench_packed_transformer(jax), deadline, "packed"
+                ),
+                "packed",
+            )
+            if result.get("median"):
+                pk["vs_unpacked_pairs_rate"] = round(
+                    pk["pairs_per_sec_chip"] / (result["median"] / SEQ), 2
+                )
+            result["packed"] = pk
+        except Exception as e:
+            log(traceback.format_exc())
+            result["packed"] = {"error": repr(e)}
             suspect = suspect or isinstance(e, TimeoutError)
     if (
         jax.devices()[0].platform == "tpu"

@@ -268,6 +268,21 @@ def train_translator(
 ) -> dict:
     r = with_overrides(recipe or TranslationRecipe(), overrides)
 
+    if r.pack_sequences:
+        # Validate BEFORE the data section: packing a real corpus is an
+        # O(corpus) host pass — never pay it just to raise afterwards.
+        blockers = {
+            "bucket_by_length": r.bucket_by_length,
+            "sequence_parallel": r.sequence_parallel > 1,
+            "pipeline_parallel": r.pipeline_parallel > 1,
+            "moe_experts": r.moe_experts > 0,
+        }
+        bad = [k for k, v in blockers.items() if v]
+        if bad:
+            raise ValueError(
+                f"pack_sequences is incompatible with {bad} (see the "
+                f"recipe field's rationale)"
+            )
     if r.data_root:
         pairs = load_multi30k(r.data_root, "train")
         val_pairs = load_multi30k(r.data_root, "valid")
@@ -356,19 +371,6 @@ def train_translator(
             "scanned dispatch stacks K batches into one static shape, but "
             "buckets emit per-bucket widths"
         )
-    if r.pack_sequences:
-        blockers = {
-            "bucket_by_length": r.bucket_by_length,
-            "sequence_parallel": r.sequence_parallel > 1,
-            "pipeline_parallel": r.pipeline_parallel > 1,
-            "moe_experts": r.moe_experts > 0,
-        }
-        bad = [k for k, v in blockers.items() if v]
-        if bad:
-            raise ValueError(
-                f"pack_sequences is incompatible with {bad} (see the "
-                f"recipe field's rationale)"
-            )
     if r.pipeline_parallel > 1:
         # The pipeline schedule supports dp×pp meshes only (TP/SP inside a
         # stage and MoE capacity routing are out of scope for the ring).
