@@ -189,6 +189,20 @@ class TestPackedRecipe:
         )
         assert "test_loss" in out  # unpacked eval path still runs
 
+    def test_composes_with_scanned_trainer(self):
+        # Packed 6-tuple batches flow through the scanned dispatch path
+        # (shard_batch_stack / make_multi_step are pytree-generic).
+        from machine_learning_apache_spark_tpu.recipes.translation import (
+            train_translator,
+        )
+
+        out = train_translator(
+            epochs=2, synthetic_n=192, batch_size=8, max_len=48,
+            d_model=32, ffn_hidden=64, num_heads=2, log_every=0,
+            pack_sequences=True, steps_per_call=2,
+        )
+        assert out["history"][-1]["loss"] < out["history"][0]["loss"]
+
     def test_incompatibilities_raise(self):
         from machine_learning_apache_spark_tpu.recipes.translation import (
             train_translator,
