@@ -196,6 +196,22 @@ class TestRecipeResume:
         assert "resumed_from_step" not in train_lstm(**kw)
         assert train_lstm(**kw)["resumed_from_step"] > 0
 
+    def test_scanned_trainer_resume_step_counting(self, tmp_path):
+        """steps_per_call must not disturb the checkpoint step contract:
+        ``state.step`` counts REAL steps under K-stride dispatch (a 1-epoch
+        run of 2 global batches scanned as one K=2 dispatch must save step
+        2, not step 1), and the resumed run continues from it."""
+        from machine_learning_apache_spark_tpu.recipes.cnn import train_cnn
+
+        base = dict(
+            synthetic_n=256, batch_size=16, hidden_units=4, steps_per_call=2,
+        )
+        d = str(tmp_path / "scan_ckpt")
+        first = train_cnn(epochs=1, checkpoint_dir=d, **base)
+        assert "resumed_from_step" not in first
+        resumed = train_cnn(epochs=1, checkpoint_dir=d, **base)
+        assert resumed["resumed_from_step"] == 2  # 256/(16*8) real steps
+
     def test_translation_recipe_resumes(self, tmp_path):
         from machine_learning_apache_spark_tpu.recipes.translation import (
             train_translator,
