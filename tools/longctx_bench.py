@@ -1,0 +1,47 @@
+"""Long-context single-chip proof: MT train step at seq 2048/4096/8192,
+bf16, flash attention, measured with the bench's synced protocol.
+
+Run on a live TPU (`python tools/longctx_bench.py` from the repo root);
+writes one JSON line per config. Complements the seq-2048 training proof
+in PARITY.md with per-length throughput/MFU — the long-context
+first-class story on real hardware. Batch sizes halve as length doubles
+(constant token budget per step).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def main() -> None:
+    jax = bench._init_backend()
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"error": "needs the live TPU chip"}))
+        return
+    for seq, bpc in ((2048, 16), (4096, 8), (8192, 4)):
+        bench.SEQ = seq
+        try:
+            r = bench._with_deadline(
+                lambda: bench.bench_transformer(
+                    jax, batch_per_chip=bpc, trials=3, steps=5, warmup=5
+                ),
+                600,
+                f"longctx seq={seq}",
+            )
+            out = {
+                "seq": seq, "batch_per_chip": bpc,
+                "tokens_per_sec_chip": r["median"], "mfu": r["mfu"],
+                "spread": r["spread"],
+                "paired": r.get("paired_window", {}),
+            }
+        except Exception as e:  # noqa: BLE001 — record and continue
+            out = {"seq": seq, "batch_per_chip": bpc, "error": repr(e)}
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
