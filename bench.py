@@ -407,6 +407,7 @@ def bench_transformer(
     steps: int | None = None,
     warmup: int | None = None,
     scan_k: int = 1,
+    seq: int | None = None,
 ) -> dict:
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -429,6 +430,7 @@ def bench_transformer(
     )
 
     batch_per_chip = BATCH_PER_CHIP if batch_per_chip is None else batch_per_chip
+    seq = SEQ if seq is None else seq
     trials = TRIALS if trials is None else trials
     n_chips = jax.device_count()
     device = jax.devices()[0]
@@ -440,7 +442,7 @@ def bench_transformer(
     cfg = TransformerConfig(
         src_vocab_size=SRC_VOCAB,
         trg_vocab_size=TRG_VOCAB,
-        max_len=SEQ,
+        max_len=seq,
         num_layers=layers,
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
     )
@@ -456,8 +458,8 @@ def bench_transformer(
     batches = []
     for i in range(n_batches):
         rng = jax.random.key(i)
-        src = jax.random.randint(rng, (batch, SEQ), 1, SRC_VOCAB, dtype=jnp.int32)
-        trg = jax.random.randint(rng, (batch, SEQ), 1, TRG_VOCAB, dtype=jnp.int32)
+        src = jax.random.randint(rng, (batch, seq), 1, SRC_VOCAB, dtype=jnp.int32)
+        trg = jax.random.randint(rng, (batch, seq), 1, TRG_VOCAB, dtype=jnp.int32)
         batches.append(
             (jax.device_put(src, sharding), jax.device_put(trg, sharding))
         )
@@ -549,7 +551,7 @@ def bench_transformer(
     barrier = lambda: _value_barrier(holder)  # noqa: E731
     times = _time_trials(one_step, trials, steps, barrier)
     for t, dt in enumerate(times):
-        r = batch * SEQ * steps * scan_k / dt / n_chips
+        r = batch * seq * steps * scan_k / dt / n_chips
         log(f"jax trial {t}: {steps * scan_k} steps in {dt:.3f}s → "
             f"{r:,.0f} tokens/sec/chip")
     paired = {}
@@ -561,17 +563,17 @@ def bench_transformer(
         steps_long = steps * LONG_WINDOW
         times_long = _time_trials(one_step, trials, steps_long, barrier)
         for t, dt in enumerate(times_long):
-            r = batch * SEQ * steps_long * scan_k / dt / n_chips
+            r = batch * seq * steps_long * scan_k / dt / n_chips
             log(f"jax long trial {t}: {steps_long * scan_k} steps in "
                 f"{dt:.3f}s → {r:,.0f} tokens/sec/chip")
         paired = _paired_window_stats(
             times, times_long, steps * scan_k, steps_long * scan_k,
-            batch * SEQ / n_chips,
+            batch * seq / n_chips,
         )
         head_steps, head_times = steps_long * scan_k, times_long
-    tps = sorted(batch * SEQ * head_steps / dt / n_chips for dt in head_times)
+    tps = sorted(batch * seq * head_steps / dt / n_chips for dt in head_times)
     median = statistics.median(tps)
-    flops_step = transformer_train_flops_per_step(batch, SEQ, SEQ - 1, layers)
+    flops_step = transformer_train_flops_per_step(batch, seq, seq - 1, layers)
     peak = _peak_flops(device)
     median_dt = statistics.median(head_times)
     achieved = flops_step * head_steps / median_dt / n_chips
@@ -595,7 +597,7 @@ def bench_transformer(
     if paired:
         # MFU at the sync-free steady-state rate (diagnostic, not headline).
         steady_mfu = (
-            flops_step / (batch * SEQ) * paired["steady_state_rate"] / peak
+            flops_step / (batch * seq) * paired["steady_state_rate"] / peak
             if peak else None
         )
         if steady_mfu is not None and steady_mfu > 1.0:

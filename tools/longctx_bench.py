@@ -23,11 +23,11 @@ def main() -> None:
         print(json.dumps({"error": "needs the live TPU chip"}))
         return
     for seq, bpc in ((2048, 16), (4096, 8), (8192, 4)):
-        bench.SEQ = seq
         try:
             r = bench._with_deadline(
                 lambda: bench.bench_transformer(
-                    jax, batch_per_chip=bpc, trials=3, steps=5, warmup=5
+                    jax, batch_per_chip=bpc, trials=3, steps=5, warmup=5,
+                    seq=seq,
                 ),
                 600,
                 f"longctx seq={seq}",
@@ -41,6 +41,13 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — record and continue
             out = {"seq": seq, "batch_per_chip": bpc, "error": repr(e)}
         print(json.dumps(out), flush=True)
+        if "error" in out and "TimeoutError" in out["error"]:
+            # Same quarantine rule as bench.py: the abandoned thread may
+            # still land on the chip — later configs would measure
+            # contention, not the framework.
+            print(json.dumps({"stopped": "device quarantined after a "
+                              "hung point"}), flush=True)
+            return
 
 
 if __name__ == "__main__":
