@@ -49,6 +49,36 @@ class TestMasks:
         assert bool(m[0, 0, 0, 0]) and not bool(m[0, 0, 0, 1])
         assert not bool(m[0, 0, 2, 0])  # padded query row attends nothing
 
+    def test_segment_mask_block_diagonal(self):
+        from machine_learning_apache_spark_tpu.ops.masks import (
+            make_segment_mask,
+        )
+
+        seg = jnp.array([[1, 1, 2, 2, 0]])
+        m = make_segment_mask(seg, seg)
+        assert m.shape == (1, 1, 5, 5)
+        got = np.asarray(m[0, 0])
+        expected = np.zeros((5, 5), bool)
+        expected[:2, :2] = True  # segment 1 block
+        expected[2:4, 2:4] = True  # segment 2 block
+        # row/col 4 (segment 0 = pad) attends and is attended by nothing
+        np.testing.assert_array_equal(got, expected)
+
+    def test_segment_mask_rectangular(self):
+        from machine_learning_apache_spark_tpu.ops.masks import (
+            make_segment_mask,
+        )
+
+        q = jnp.array([[1, 2, 2]])
+        k = jnp.array([[2, 2, 1, 0, 1]])
+        m = make_segment_mask(q, k)[0, 0]
+        np.testing.assert_array_equal(
+            np.asarray(m),
+            [[False, False, True, False, True],
+             [True, True, False, False, False],
+             [True, True, False, False, False]],
+        )
+
     def test_combine(self):
         causal = make_causal_mask(4)
         pad = make_padding_mask(jnp.array([[1, 1, 0, 0]]))
