@@ -16,6 +16,7 @@ from machine_learning_apache_spark_tpu.ops.masks import (
     make_causal_mask,
     make_padding_mask,
     make_attention_mask,
+    make_segment_mask,
     combine_masks,
 )
 from machine_learning_apache_spark_tpu.ops.positional import sinusoidal_encoding
@@ -31,6 +32,7 @@ __all__ = [
     "make_causal_mask",
     "make_padding_mask",
     "make_attention_mask",
+    "make_segment_mask",
     "combine_masks",
     "sinusoidal_encoding",
     "scaled_dot_product_attention",
