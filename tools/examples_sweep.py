@@ -1,0 +1,73 @@
+"""Run every example end to end and report rc per example.
+
+`python tools/examples_sweep.py [--platform cpu|default] [--timeout S]`
+
+Used for the PARITY re-verification record: each example runs in its own
+subprocess; `--platform cpu` (the default) forces the 8-virtual-device CPU
+backend via a bootstrap (the config API, because env vars are too late
+once sitecustomize has imported jax), which is the only safe choice when
+the TPU tunnel may be down — a dead tunnel makes backend init hang, not
+fail. `--platform default` leaves the image's default (the real chip).
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXAMPLES = [
+    "mllib_multilayer_perceptron_classifier",
+    "multilayer_perceptron",
+    "lstm",
+    "cnn",
+    "machine_translator",
+    "distributed_lstm",
+    "advanced_translator",
+    "high_throughput_cnn",
+]
+
+_BOOTSTRAP = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import runpy, sys
+sys.path.insert(0, "examples")
+name = sys.argv[1]
+sys.argv = [f"examples/{name}.py"] + sys.argv[2:]
+runpy.run_path(f"examples/{name}.py", run_name="__main__")
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", choices=["cpu", "default"], default="cpu")
+    ap.add_argument("--timeout", type=float, default=3600.0)
+    ap.add_argument("examples", nargs="*", default=None)
+    ns = ap.parse_args()
+
+    failures = 0
+    for name in ns.examples or EXAMPLES:
+        if ns.platform == "cpu":
+            cmd = [sys.executable, "-c", _BOOTSTRAP, name]
+        else:
+            cmd = [sys.executable, f"examples/{name}.py"]
+        # high_throughput_cnn's comparison doubles the wall time; a smaller
+        # K keeps the CPU sweep within budget (the knob targets TPUs).
+        if name == "high_throughput_cnn" and ns.platform == "cpu":
+            cmd.append("8")
+        print(f"=== {name} ===", flush=True)
+        try:
+            rc = subprocess.run(cmd, cwd=REPO, timeout=ns.timeout).returncode
+        except subprocess.TimeoutExpired:
+            rc = 124
+        print(f"=== {name} rc={rc} ===", flush=True)
+        failures += rc != 0
+    print(f"examples sweep: {len(ns.examples or EXAMPLES) - failures}/"
+          f"{len(ns.examples or EXAMPLES)} rc=0")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
