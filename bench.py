@@ -126,6 +126,18 @@ def _init_backend():
     """
     import jax
 
+    # Unconditionally, before anything can log: the bench's stdout is ONE
+    # machine-parsed JSON line, but package loggers default to stdout (the
+    # examples' print-vocabulary parity) — a stray per-epoch or cache log
+    # line on stdout would corrupt the driver-parsed artifact.
+    try:
+        from machine_learning_apache_spark_tpu.utils.logging import (
+            route_logging_to_stderr,
+        )
+
+        route_logging_to_stderr()
+    except Exception as e:
+        log(f"logging reroute unavailable: {e!r}")
     _enable_compile_cache()
     if os.environ.get("BENCH_PLATFORM"):  # e.g. "cpu" for smoke runs
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
@@ -368,6 +380,19 @@ def _check_mfu(achieved: float, peak: float | None, label: str) -> float | None:
             f"defeated (async-ack relay?); measurement invalid"
         )
     return mfu
+
+
+def _tpu_stages(jax) -> bool:
+    """Gate for the TPU-only stages (scanned/packed/sweep) in main().
+
+    BENCH_FORCE_TPU_STAGES=1 opens the gate on any backend — a smoke hook
+    so the stage GLUE (retry/deadline wrappers, result merging) can be
+    executed on CPU with tiny plans; without it, glue bugs would first
+    surface on the driver's end-of-round TPU run.
+    """
+    if os.environ.get("BENCH_FORCE_TPU_STAGES", "") not in ("", "0"):
+        return True
+    return jax.devices()[0].platform == "tpu"
 
 
 def _degraded_mode_knobs(jax) -> None:
@@ -1063,10 +1088,8 @@ def main() -> None:
         log(traceback.format_exc())
         result["error"] = repr(e)
         suspect = suspect or isinstance(e, TimeoutError)
-    if (
-        jax.devices()[0].platform == "tpu"
-        and not suspect
-        and not os.environ.get("BENCH_SKIP_SCANNED")
+    if _tpu_stages(jax) and not suspect and not os.environ.get(
+        "BENCH_SKIP_SCANNED"
     ):
         # The same MT workload through the scanned product path
         # (fit(steps_per_call=K) semantics): K=8 steps per dispatch removes
@@ -1094,10 +1117,8 @@ def main() -> None:
             log(traceback.format_exc())
             result["scanned"] = {"error": repr(e)}
             suspect = suspect or isinstance(e, TimeoutError)
-    if (
-        jax.devices()[0].platform == "tpu"
-        and not suspect
-        and not os.environ.get("BENCH_SKIP_PACKED")
+    if _tpu_stages(jax) and not suspect and not os.environ.get(
+        "BENCH_SKIP_PACKED"
     ):
         # Sequence packing on the same workload: pairs/sec/chip against the
         # fixed-width layout's (token rate)/SEQ ceiling.
@@ -1117,10 +1138,8 @@ def main() -> None:
             log(traceback.format_exc())
             result["packed"] = {"error": repr(e)}
             suspect = suspect or isinstance(e, TimeoutError)
-    if (
-        jax.devices()[0].platform == "tpu"
-        and not suspect
-        and not os.environ.get("BENCH_SKIP_SWEEP")
+    if _tpu_stages(jax) and not suspect and not os.environ.get(
+        "BENCH_SKIP_SWEEP"
     ):
         # Own try-block, gated on the platform (not the headline result):
         # neither a headline failure nor a sweep failure may void the other,

@@ -13,13 +13,16 @@ import sys
 import jax
 
 _LOGGERS: dict[str, logging.Logger] = {}
+# stdout by default: the examples' metric lines (Training Time, accuracy)
+# reproduce the reference's print vocabulary on the reference's stream.
+_DEFAULT_STREAM = sys.stdout
 
 
 def get_logger(name: str = "mlspark") -> logging.Logger:
     if name not in _LOGGERS:
         logger = logging.getLogger(name)
         if not logger.handlers:
-            handler = logging.StreamHandler(sys.stdout)
+            handler = logging.StreamHandler(_DEFAULT_STREAM)
             handler.setFormatter(
                 logging.Formatter("[%(asctime)s %(name)s] %(message)s", "%H:%M:%S")
             )
@@ -28,6 +31,21 @@ def get_logger(name: str = "mlspark") -> logging.Logger:
             logger.propagate = False
         _LOGGERS[name] = logger
     return _LOGGERS[name]
+
+
+def route_logging_to_stderr() -> None:
+    """Retarget every package logger (existing and future) to stderr.
+
+    For processes whose stdout is a machine-parsed artifact — bench.py's
+    contract is ONE JSON line on stdout — where a stray log line (e.g. the
+    compilation-cache enable notice) would corrupt the artifact stream.
+    """
+    global _DEFAULT_STREAM
+    _DEFAULT_STREAM = sys.stderr
+    for logger in _LOGGERS.values():
+        for h in logger.handlers:
+            if isinstance(h, logging.StreamHandler):
+                h.setStream(sys.stderr)
 
 
 def rank_zero_print(*args, all_ranks: bool = False, **kwargs) -> None:

@@ -1,0 +1,125 @@
+"""bench.py main() stage glue, executed with stubbed workloads.
+
+The scanned/packed/sweep stages are TPU-gated, so their GLUE (deadline/
+retry wrappers, result merging, quarantine propagation) never runs in CPU
+smoke runs — a NameError there would first surface on the driver's
+end-of-round TPU run, which is exactly the artifact that must never be
+lost. These tests open the gate (BENCH_FORCE_TPU_STAGES) and drive main()
+with canned workload results, so every glue path executes in milliseconds.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+MT = {
+    "median": 600000.0, "max": 620000.0, "trials": [600000.0],
+    "spread": 1.03, "steps_per_trial": 240, "scan_k": 1,
+    "flops_per_step": 4.2e11, "achieved_flops_per_sec_chip": 4e13,
+    "mfu": 0.21, "device": "TPU v5 lite", "n_chips": 1,
+    "batch_per_chip": 32, "layers": 1, "loss": 1.0,
+    "paired_window": {"steady_state_rate": 700000.0},
+}
+CNN = {
+    "value": 1000000.0, "unit": "samples/sec/chip", "median": 1000000.0,
+    "max": 1.1e6, "trials": [1e6], "spread": 1.1, "steps_per_trial": 2000,
+    "scan_k": 50, "mfu": 0.03, "batch_per_chip": 512,
+}
+PACKED = {
+    "pairs_per_sec_chip": 30000.0, "max": 31000.0, "spread": 1.03,
+    "pairs_per_row": 11.5, "token_efficiency": 0.89,
+    "unpacked_token_efficiency": 0.08, "loss": 2.0,
+}
+
+
+@pytest.fixture
+def stage_env(monkeypatch):
+    monkeypatch.setenv("BENCH_FORCE_TPU_STAGES", "1")
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    monkeypatch.setattr(bench, "bench_torch_transformer", lambda: 1200.0)
+    monkeypatch.setattr(bench, "bench_torch_cnn", lambda: 3000.0)
+    monkeypatch.setattr(bench, "bench_cnn", lambda jax: dict(CNN))
+    return monkeypatch
+
+
+def _run_main(capsys):
+    bench.main()
+    # The artifact contract: stdout is EXACTLY one JSON line (package
+    # loggers are rerouted to stderr by _init_backend; a stray log line
+    # here is a driver-facing regression).
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, f"stdout must be one JSON line, got {lines}"
+    return json.loads(lines[0])
+
+
+def test_all_stages_merge(stage_env, capsys):
+    stage_env.setattr(
+        bench, "bench_transformer", lambda jax, **kw: dict(MT)
+    )
+    stage_env.setattr(
+        bench, "bench_packed_transformer", lambda jax, **kw: dict(PACKED)
+    )
+    stage_env.setattr(
+        bench, "bench_transformer_sweep",
+        lambda jax, points=None, stop_at=None: [
+            {"batch_per_chip": 128, "layers": 1, "tokens_per_sec_chip": 7e5}
+        ],
+    )
+    out = _run_main(capsys)
+    assert out["value"] == 600000.0
+    assert out["vs_baseline"] == 500.0
+    assert out["scanned"]["median"] == 600000.0  # sliced keys present
+    assert out["packed"]["pairs_per_sec_chip"] == 30000.0
+    # 600000/200 = 3000 pairs/s unpacked ceiling → 10x
+    assert out["packed"]["vs_unpacked_pairs_rate"] == 10.0
+    assert out["sweep"][0]["batch_per_chip"] == 128
+    assert out["cnn"]["vs_baseline"] == round(1000000.0 / 3000.0, 3)
+    assert "after_timeout" not in out["cnn"]
+
+
+def test_headline_timeout_quarantines_later_stages(stage_env, capsys):
+    def hung(jax, **kw):
+        raise TimeoutError("transformer deadline (900s) exceeded")
+
+    stage_env.setattr(bench, "bench_transformer", hung)
+    called = {"packed": 0, "sweep": 0}
+    stage_env.setattr(
+        bench, "bench_packed_transformer",
+        lambda jax, **kw: called.__setitem__("packed", 1) or dict(PACKED),
+    )
+    stage_env.setattr(
+        bench, "bench_transformer_sweep",
+        lambda jax, points=None, stop_at=None: called.__setitem__("sweep", 1) or [],
+    )
+    out = _run_main(capsys)
+    assert "TimeoutError" in out["error"]
+    assert called == {"packed": 0, "sweep": 0}  # skipped, not run
+    assert "scanned" not in out
+    # CNN kept for artifact completeness but flagged untrustworthy.
+    assert out["cnn"]["after_timeout"] is True
+
+
+def test_stage_failure_does_not_void_others(stage_env, capsys):
+    stage_env.setattr(
+        bench, "bench_transformer", lambda jax, **kw: dict(MT)
+    )
+    stage_env.setattr(
+        bench, "bench_packed_transformer",
+        lambda jax, **kw: (_ for _ in ()).throw(ValueError("boom")),
+    )
+    stage_env.setattr(
+        bench, "bench_transformer_sweep",
+        lambda jax, points=None, stop_at=None: [],
+    )
+    out = _run_main(capsys)
+    assert out["value"] == 600000.0  # headline intact
+    assert "error" in out["packed"]
+    assert "sweep" in out  # non-timeout failure does not quarantine
+    assert "after_timeout" not in out["cnn"]
