@@ -50,8 +50,23 @@ def enable_compilation_cache(
     # (set above); a large program that compiled slowly but serializes
     # small is exactly the case worth keeping.
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _reset_cache_singleton()
     log.info("persistent compilation cache at %s", path)
     return path
+
+
+def _reset_cache_singleton() -> None:
+    """Drop JAX's lazily-initialized cache object so a dir change takes
+    effect: once the internal singleton binds to a directory, later
+    ``jax_compilation_cache_dir`` updates are silently ignored for the
+    life of the process. Private API, so best-effort — on JAX versions
+    without it, only the FIRST enable in a process picks the dir."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
 
 
 def disable_compilation_cache() -> None:
@@ -63,3 +78,4 @@ def disable_compilation_cache() -> None:
         "jax_persistent_cache_min_compile_time_secs", _DEFAULT_MIN_COMPILE_SECS
     )
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _reset_cache_singleton()

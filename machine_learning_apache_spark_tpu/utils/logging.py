@@ -33,6 +33,22 @@ def get_logger(name: str = "mlspark") -> logging.Logger:
     return _LOGGERS[name]
 
 
+class _StderrProxy:
+    """File-like object resolving ``sys.stderr`` at EVERY write.
+
+    Binding the stderr *object* at reroute time breaks under test
+    harnesses that swap/close ``sys.stderr`` per test (pytest capture): a
+    later log line would hit a closed stream and spray '--- Logging
+    error ---'. Late binding always reaches whatever stderr currently is.
+    """
+
+    def write(self, s):  # noqa: D102 — file protocol
+        return sys.stderr.write(s)
+
+    def flush(self):  # noqa: D102
+        return sys.stderr.flush()
+
+
 def route_logging_to_stderr() -> None:
     """Retarget every package logger (existing and future) to stderr.
 
@@ -41,11 +57,12 @@ def route_logging_to_stderr() -> None:
     compilation-cache enable notice) would corrupt the artifact stream.
     """
     global _DEFAULT_STREAM
-    _DEFAULT_STREAM = sys.stderr
+    proxy = _StderrProxy()
+    _DEFAULT_STREAM = proxy
     for logger in _LOGGERS.values():
         for h in logger.handlers:
             if isinstance(h, logging.StreamHandler):
-                h.setStream(sys.stderr)
+                h.setStream(proxy)
 
 
 def rank_zero_print(*args, all_ranks: bool = False, **kwargs) -> None:
