@@ -33,6 +33,11 @@ Prints ONE JSON line:
 
 Never exits non-zero for a measurement failure: any error is reported inside
 the JSON (``"error"``) with value 0, so the artifact always parses.
+
+Evidence contract: when the live backend is a CPU fallback (dead tunnel at
+driver time), the artifact embeds ``tpu_evidence`` — the newest committed
+on-chip record (``TPU_EVIDENCE.json``, capture-dated) — so the artifact of
+record always carries a TPU number. On-chip runs refresh that record.
 """
 
 from __future__ import annotations
@@ -53,14 +58,19 @@ D_MODEL, FFN, HEADS, LAYERS = 512, 1024, 8, 1
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 # On TPU the chip+tunnel ramp for ~100+ steps before reaching steady state
 # (r04 headline trials climbed monotonically 133K→224K tok/s); a longer
-# warmup puts every measured window past the ramp. Env override wins.
-TPU_WARMUP = int(os.environ.get("BENCH_WARMUP", "60"))
+# warmup puts every measured window past the ramp. Per-backend env var
+# (BENCH_TPU_*) wins over the generic one, which wins over the default.
+def _env_int(specific: str, generic: str, default: int) -> int:
+    return int(os.environ.get(specific, os.environ.get(generic, default)))
+
+
+TPU_WARMUP = _env_int("BENCH_TPU_WARMUP", "BENCH_WARMUP", 60)
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 # TPU windows must dwarf the ~0.08-0.2s per-trial sync: the MT step is
 # ~8.4ms on a v5e (60 steps ≈ 0.5s short window, 240-step long window ≈ 2s
 # → sync < 10% of the long window); the CNN step is ~0.65ms, needing ~500.
-TPU_STEPS = int(os.environ.get("BENCH_STEPS", "60"))
-TPU_CNN_STEPS = int(os.environ.get("BENCH_CNN_STEPS", "500"))
+TPU_STEPS = _env_int("BENCH_TPU_STEPS", "BENCH_STEPS", 60)
+TPU_CNN_STEPS = _env_int("BENCH_TPU_CNN_STEPS", "BENCH_CNN_STEPS", 500)
 TRIALS = int(os.environ.get("BENCH_TRIALS", "10"))
 # Long-window multiplier for the TPU paired-window protocol (see
 # _paired_window_stats): windows of STEPS and LONG_WINDOW×STEPS are both
@@ -380,6 +390,100 @@ def _check_mfu(achieved: float, peak: float | None, label: str) -> float | None:
             f"defeated (async-ack relay?); measurement invalid"
         )
     return mfu
+
+
+_EVIDENCE_PATH = os.path.join(os.path.dirname(__file__), "TPU_EVIDENCE.json")
+
+
+def _load_tpu_evidence() -> dict | None:
+    """Newest committed on-chip record, for embedding when the live backend
+    is a CPU fallback. The driver artifact has read "cpu" whenever the
+    tunnel happened to be dead at end-of-round (4/4 rounds), while the real
+    TPU measurements sat in separately committed BENCH_SELF_* files — this
+    puts them in the artifact of record, clearly labeled with capture date.
+    """
+    try:
+        with open(_EVIDENCE_PATH) as f:
+            return json.load(f)
+    except Exception as e:
+        log(f"no committed TPU evidence available: {e!r}")
+        return None
+
+
+def _record_tpu_evidence(result: dict) -> None:
+    """After a successful on-chip run, refresh TPU_EVIDENCE.json so future
+    CPU-fallback artifacts embed the newest numbers. MERGES into the
+    existing record: only stages that actually measured this run overwrite
+    their keys, so a partial run (e.g. CNN errored) never erases the last
+    good number for the other workloads. Best-effort: a read-only checkout
+    must not fail the bench."""
+    ev: dict = _load_tpu_evidence() or {}
+    ev.update({
+        "captured": time.strftime("%Y-%m-%d"),
+        "round": os.environ.get("BENCH_ROUND", "self"),
+        "note": (
+            "Curated record of the newest committed on-chip measurements; "
+            "embedded as 'tpu_evidence' in CPU-fallback artifacts. "
+            "Auto-refreshed (merge per stage) by bench.py after a "
+            "successful on-chip run; per-stage capture dates in "
+            "'stage_captured'."
+        ),
+    })
+    stamped: list[str] = []
+    if result.get("median") and not result.get("error"):
+        stamped.append("transformer")
+        ev["transformer"] = {
+            "median_tokens_per_sec_chip": result["median"],
+            "mfu": result.get("mfu"),
+            "spread": result.get("spread"),
+            "batch_per_chip": result.get("batch_per_chip"),
+            "layers": result.get("layers"),
+            "seq": SEQ,
+            "protocol": (
+                f"warmup={TPU_WARMUP}, {TRIALS} trials x "
+                f"{result.get('steps_per_trial')}-step synced windows, "
+                "value-fetch barrier"
+            ),
+            "source": "bench.py on-chip run",
+        }
+        pw = result.get("paired_window")
+        if pw:
+            ev["transformer"]["paired_window_steady_state"] = {
+                "tokens_per_sec_chip": pw.get("steady_state_rate"),
+                "mfu": pw.get("steady_state_mfu"),
+            }
+    for key in ("scanned", "packed", "sweep"):
+        if key == "sweep" and result.get("sweep_error"):
+            continue  # partial sweep must not erase the last complete one
+        if result.get(key) and not (
+            isinstance(result[key], dict) and result[key].get("error")
+        ):
+            stamped.append(key)
+            ev[key] = result[key]
+    cnn = result.get("cnn")
+    if isinstance(cnn, dict) and cnn.get("median") and not cnn.get("error"):
+        stamped.append("cnn_scanned")
+        ev["cnn_scanned"] = {
+            "median_samples_per_sec_chip": cnn["median"],
+            "spread": cnn.get("spread"),
+            "scan_k": cnn.get("scan_k"),
+            "mfu": cnn.get("mfu"),
+            "batch_per_chip": cnn.get("batch_per_chip"),
+            "source": "bench.py on-chip run",
+        }
+    if not stamped:
+        return  # nothing measured on chip this run; keep the old record
+    dates = dict(ev.get("stage_captured") or {})
+    dates.update({k: ev["captured"] for k in stamped})
+    ev["stage_captured"] = dates
+    try:
+        with open(_EVIDENCE_PATH, "w") as f:
+            json.dump(ev, f, indent=2)
+            f.write("\n")
+        log(f"TPU evidence record refreshed at {_EVIDENCE_PATH} "
+            f"(stages: {', '.join(stamped)})")
+    except Exception as e:
+        log(f"could not refresh TPU evidence record: {e!r}")
 
 
 def _tpu_stages(jax) -> bool:
@@ -1185,6 +1289,19 @@ def main() -> None:
     except Exception as e:
         log(traceback.format_exc())
         result["cnn"] = {"error": repr(e)}
+    # The evidence contract (VERDICT r04 item 2): a TPU number in the
+    # artifact whichever way the tunnel rolls. On-chip runs refresh the
+    # committed record; CPU fallbacks embed it, labeled with capture date.
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    if on_tpu and not suspect:
+        _record_tpu_evidence(result)
+    elif not on_tpu:
+        ev = _load_tpu_evidence()
+        if ev:
+            result["tpu_evidence"] = ev
     print(json.dumps(result))
 
 

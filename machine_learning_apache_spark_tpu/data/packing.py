@@ -49,6 +49,11 @@ class PackedPairs:
     pair_count: int
     token_efficiency: float
     unpacked_efficiency: float
+    # Pairs excluded because truncation left no attendable src or no
+    # scorable trg (<2 tokens). Can't trigger on the standard SOS/EOS
+    # pipeline, but raw-id callers need the signal — silent corpus
+    # shrinkage would otherwise only show as a reduced pair_count.
+    dropped_pairs: int = 0
 
     def arrays(self) -> tuple[np.ndarray, ...]:
         return (
@@ -96,11 +101,13 @@ def pack_translation_pairs(
             rows.append((open_src, open_trg))
         open_src, open_trg, used_s, used_t = [], [], 0, 0
 
+    dropped = 0
     for s, t in zip(src_rows, trg_rows):
         s = list(s)[:src_len]
         t = list(t)[:trg_len]
         if not s or len(t) < 2:
-            continue  # nothing attendable / nothing scorable
+            dropped += 1  # nothing attendable / nothing scorable
+            continue
         full = (
             used_s + len(s) > src_len
             or used_t + len(t) > trg_len
@@ -125,6 +132,7 @@ def pack_translation_pairs(
         pair_count=sum(len(r[0]) for r in rows),
         token_efficiency=0.0,
         unpacked_efficiency=0.0,
+        dropped_pairs=dropped,
     )
     tokens = 0
     for i, (srcs, trgs) in enumerate(rows):

@@ -62,12 +62,47 @@ class SessionBuilder:
                 # Spark semantics: getOrCreate() returns the existing
                 # session and conf on the builder is NOT applied. Silent
                 # drops are expensive (e.g. a compilation_cache_dir that
-                # never enables costs its full compile time) — say so.
-                log.warning(
-                    "getOrCreate(): active session exists; builder conf %s "
-                    "ignored (stop() the session first to apply it)",
-                    sorted(self._conf),
+                # never enables costs its full compile time) — but only
+                # keys that actually DIFFER from the active session are
+                # dropped in any meaningful sense; idempotent re-creation
+                # with identical conf should stay quiet.
+                active = _ACTIVE_SESSION.conf
+                fields = {f.name: f for f in dataclasses.fields(SessionConfig)}
+
+                def _resolved(k, v):
+                    # Compare post-coercion, the way creation would apply it
+                    # ("8" matches an active executor count of 8). An
+                    # uncoercible value can't match anything — return it
+                    # raw so it counts as differing (warn, never raise:
+                    # the conf is ignored either way under Spark
+                    # getOrCreate semantics).
+                    if k in fields and isinstance(v, str):
+                        try:
+                            return _coerce(v, type(fields[k].default))
+                        except (TypeError, ValueError):
+                            return v
+                    return v
+
+                unknown = sorted(k for k in self._conf if k not in fields)
+                differing = sorted(
+                    k for k, v in self._conf.items()
+                    if k in fields and getattr(active, k) != _resolved(k, v)
                 )
+                if differing:
+                    log.warning(
+                        "getOrCreate(): active session exists; builder conf "
+                        "%s ignored (stop() the session first to apply it)",
+                        differing,
+                    )
+                if unknown:
+                    # Not a stop()-and-retry situation: creation would drop
+                    # these too. Distinct message so the user isn't sent on
+                    # a futile restart cycle.
+                    log.warning(
+                        "getOrCreate(): conf keys %s match no SessionConfig "
+                        "field and are unsupported (ignored on creation too)",
+                        unknown,
+                    )
             if _ACTIVE_SESSION is None:
                 fields = {f.name: f for f in dataclasses.fields(SessionConfig)}
                 kwargs = {}

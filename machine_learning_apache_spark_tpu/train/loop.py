@@ -264,6 +264,7 @@ def _run_epochs(
 
     history: list[dict] = []
     global_step = 0
+    last_emit_step = global_step
     for epoch in range(epochs):
         if hasattr(train_loader, "set_epoch"):
             train_loader.set_epoch(epoch)
@@ -290,10 +291,17 @@ def _run_epochs(
             )
 
         def _emit_log():
+            # The lap spans however many batches actually ran since the last
+            # emit — with a multi-step stride that need not equal log_every
+            # (one K-step dispatch can cross several boundaries), so report
+            # the real count.
+            nonlocal last_emit_step
+            covered = global_step - last_emit_step
+            last_emit_step = global_step
             _drain()
             emit(
                 f"epoch {epoch} step {global_step} | "
-                f"{epoch_metrics.log_line()} | {span_timer.lap():.3f} sec/{log_every} batches"
+                f"{epoch_metrics.log_line()} | {span_timer.lap():.3f} sec/{covered} batches"
             )
 
         group: list = []

@@ -61,7 +61,11 @@ def route_logging_to_stderr() -> None:
     _DEFAULT_STREAM = proxy
     for logger in _LOGGERS.values():
         for h in logger.handlers:
-            if isinstance(h, logging.StreamHandler):
+            # FileHandler subclasses StreamHandler; retargeting one would
+            # silently divert a file log to stderr.
+            if isinstance(h, logging.StreamHandler) and not isinstance(
+                h, logging.FileHandler
+            ):
                 h.setStream(proxy)
 
 

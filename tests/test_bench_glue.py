@@ -106,6 +106,77 @@ def test_headline_timeout_quarantines_later_stages(stage_env, capsys):
     assert out["cnn"]["after_timeout"] is True
 
 
+def test_cpu_fallback_embeds_tpu_evidence(stage_env, capsys):
+    """VERDICT r04 item 2: a dead tunnel at driver time must not produce an
+    artifact with zero TPU numbers — the committed record rides along."""
+    stage_env.setattr(bench, "bench_transformer", lambda jax, **kw: dict(MT))
+    stage_env.setattr(
+        bench, "bench_packed_transformer", lambda jax, **kw: dict(PACKED)
+    )
+    stage_env.setattr(
+        bench, "bench_transformer_sweep",
+        lambda jax, points=None, stop_at=None: [],
+    )
+    out = _run_main(capsys)
+    ev = out["tpu_evidence"]
+    assert ev["captured"]  # capture-dated, never passed off as live
+    assert ev["transformer"]["median_tokens_per_sec_chip"] > 0
+    # mfu may legitimately be None (unknown device kind) — just present.
+    assert "mfu" in ev["transformer"]
+
+
+def test_record_tpu_evidence_roundtrip(tmp_path, monkeypatch):
+    """An on-chip run refreshes the committed record with every stage that
+    succeeded, and a subsequent load returns it."""
+    monkeypatch.setattr(bench, "_EVIDENCE_PATH", str(tmp_path / "ev.json"))
+    result = dict(MT)
+    result["scanned"] = {"median": 900000.0, "scan_k": 8}
+    result["packed"] = dict(PACKED)
+    result["cnn"] = dict(CNN)
+    bench._record_tpu_evidence(result)
+    ev = bench._load_tpu_evidence()
+    assert ev["transformer"]["median_tokens_per_sec_chip"] == 600000.0
+    assert ev["transformer"]["paired_window_steady_state"][
+        "tokens_per_sec_chip"
+    ] == 700000.0
+    assert ev["scanned"]["median"] == 900000.0
+    assert ev["packed"]["pairs_per_sec_chip"] == 30000.0
+    assert ev["cnn_scanned"]["median_samples_per_sec_chip"] == 1000000.0
+
+
+def test_record_merges_per_stage(tmp_path, monkeypatch):
+    """A partial run must not erase the last good number for stages it
+    didn't measure: transformer-only then cnn-only leaves both on record,
+    with per-stage capture dates."""
+    monkeypatch.setattr(bench, "_EVIDENCE_PATH", str(tmp_path / "ev.json"))
+    bench._record_tpu_evidence(dict(MT))
+    cnn_only = {"cnn": dict(CNN)}
+    bench._record_tpu_evidence(cnn_only)
+    ev = bench._load_tpu_evidence()
+    assert ev["transformer"]["median_tokens_per_sec_chip"] == 600000.0
+    assert ev["cnn_scanned"]["median_samples_per_sec_chip"] == 1000000.0
+    assert set(ev["stage_captured"]) == {"transformer", "cnn_scanned"}
+
+
+def test_record_skips_failed_stages(tmp_path, monkeypatch):
+    """A failed stage must not overwrite the record with an error dict (or
+    a partial sweep), and a run where nothing succeeded must leave the old
+    record untouched."""
+    path = tmp_path / "ev.json"
+    monkeypatch.setattr(bench, "_EVIDENCE_PATH", str(path))
+    ok = dict(MT)
+    ok["packed"] = {"error": "TimeoutError(...)"}
+    ok["sweep"] = [{"batch_per_chip": 128, "layers": 1}]  # salvage list...
+    ok["sweep_error"] = "ValueError('mid-sweep crash')"  # ...from a crash
+    bench._record_tpu_evidence(ok)
+    ev = bench._load_tpu_evidence()
+    assert "packed" not in ev
+    assert "sweep" not in ev  # partial sweep must not look complete
+    before = path.read_text()
+    bench._record_tpu_evidence({"error": "boom", "cnn": {"error": "x"}})
+    assert path.read_text() == before  # nothing measured → keep old record
+
+
 def test_stage_failure_does_not_void_others(stage_env, capsys):
     stage_env.setattr(
         bench, "bench_transformer", lambda jax, **kw: dict(MT)

@@ -37,6 +37,47 @@ class TestSession:
         assert s1 is s2
         s1.stop()
 
+    def test_get_or_create_warns_only_on_differing_conf(self):
+        """Idempotent re-creation with identical conf stays quiet; only
+        keys that would actually change the active session warn (Spark
+        semantics: builder conf is never applied to an existing session).
+        The package logger doesn't propagate to root (it owns its stream
+        handler), so capture with a handler attached to it directly."""
+        import logging
+
+        records: list[logging.LogRecord] = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        session_log = logging.getLogger(
+            "machine_learning_apache_spark_tpu.session"
+        )
+        cap = Capture(level=logging.WARNING)
+        session_log.addHandler(cap)
+        s = (
+            mlspark.Session.builder.appName("warn-test")
+            .config("spark.executor.instances", 4)
+            .getOrCreate()
+        )
+        try:
+            # Same conf (string value coerces to the active int) → quiet.
+            mlspark.Session.builder.appName("warn-test").config(
+                "spark.executor.instances", "4"
+            ).getOrCreate()
+            assert not [r for r in records if "ignored" in r.getMessage()]
+            # Differing value → warns, naming only the differing key.
+            mlspark.Session.builder.appName("warn-test").config(
+                "spark.executor.instances", 8
+            ).getOrCreate()
+            warns = [r for r in records if "ignored" in r.getMessage()]
+            assert warns and "executor_instances" in warns[0].getMessage()
+            assert "app_name" not in warns[0].getMessage()
+        finally:
+            session_log.removeHandler(cap)
+            s.stop()
+
     def test_spark_style_conf_keys(self):
         s = (
             mlspark.Session.builder.appName("conf-test")

@@ -81,6 +81,20 @@ class TestPacker:
         with pytest.raises(ValueError, match="mismatch"):
             pack_translation_pairs([[1]], [], src_len=4, trg_len=4)
 
+    def test_dropped_pairs_counted(self):
+        # Raw-id callers (no SOS/EOS) can feed unscorable pairs: empty src
+        # or single-token trg. Those are excluded, and the exclusion must
+        # be visible, not just a silently smaller pair_count.
+        p = pack_translation_pairs(
+            [[1, 2], [], [3]], [[4, 5], [6, 7], [8]], src_len=8, trg_len=8
+        )
+        assert p.pair_count == 1
+        assert p.dropped_pairs == 2
+        clean = pack_translation_pairs(
+            [[1, 2]], [[4, 5]], src_len=8, trg_len=8
+        )
+        assert clean.dropped_pairs == 0
+
 
 def _tiny_model():
     cfg = TransformerConfig(
