@@ -29,7 +29,8 @@ the artifact), with hard failures retried once when transient.
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N,
    "median": N, "max": N, "trials": [...], "spread": N, "mfu": N,
-   "device": ..., "cnn": {"value": N, "unit": "samples/sec/chip", ...}}
+   "device": ..., "scanned": {...}, "packed": {...}, "composed": {...},
+   "sweep": [...], "cnn": {"value": N, "unit": "samples/sec/chip", ...}}
 
 Never exits non-zero for a measurement failure: any error is reported inside
 the JSON (``"error"``) with value 0, so the artifact always parses.
@@ -452,8 +453,15 @@ def _record_tpu_evidence(result: dict) -> None:
                 "tokens_per_sec_chip": pw.get("steady_state_rate"),
                 "mfu": pw.get("steady_state_mfu"),
             }
-    for key in ("scanned", "packed", "sweep"):
-        if key == "sweep" and result.get("sweep_error"):
+    for key in ("scanned", "packed", "composed", "sweep"):
+        if key == "sweep" and (
+            result.get("sweep_error")
+            or any(
+                "error" in p or "truncated" in p
+                for p in result.get("sweep") or []
+                if isinstance(p, dict)
+            )
+        ):
             continue  # partial sweep must not erase the last complete one
         if result.get(key) and not (
             isinstance(result[key], dict) and result[key].get("error")
@@ -477,9 +485,14 @@ def _record_tpu_evidence(result: dict) -> None:
     dates.update({k: ev["captured"] for k in stamped})
     ev["stage_captured"] = dates
     try:
-        with open(_EVIDENCE_PATH, "w") as f:
+        # Atomic replace: a SIGTERM mid-write (the watcher wraps bench.py
+        # in `timeout`) must not truncate the one record the whole
+        # evidence contract depends on.
+        tmp = _EVIDENCE_PATH + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(ev, f, indent=2)
             f.write("\n")
+        os.replace(tmp, _EVIDENCE_PATH)
         log(f"TPU evidence record refreshed at {_EVIDENCE_PATH} "
             f"(stages: {', '.join(stamped)})")
     except Exception as e:
@@ -740,6 +753,30 @@ def bench_transformer(
     return out
 
 
+def _synthetic_packed_corpus(n_pairs: int):
+    """Multi30k-shaped ragged pairs (clipped-normal lengths, mean ~15 src /
+    ~17 trg vs the reference's fixed 200-token rows,
+    ``pytorch_machine_translator.py:70-98``), packed to the bench grid.
+    Shared by the packed and composed stages so their pairs/sec numbers
+    measure the same corpus distribution."""
+    import numpy as np
+
+    from machine_learning_apache_spark_tpu.data.packing import (
+        pack_translation_pairs,
+    )
+
+    rng = np.random.default_rng(0)
+
+    def ragged(n, vocab, mean):
+        lens = np.clip(rng.normal(mean, 5.0, n), 4, 60).astype(int)
+        return [list(rng.integers(4, vocab, l)) for l in lens]
+
+    return pack_translation_pairs(
+        ragged(n_pairs, SRC_VOCAB, 15.0), ragged(n_pairs, TRG_VOCAB, 17.0),
+        src_len=SEQ, trg_len=SEQ,
+    )
+
+
 def bench_packed_transformer(
     jax, *, trials: int = 3, steps: int = 10, warmup: int = 10
 ) -> dict:
@@ -756,9 +793,6 @@ def bench_packed_transformer(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from machine_learning_apache_spark_tpu.data.packing import (
-        pack_translation_pairs,
-    )
     from machine_learning_apache_spark_tpu.models import (
         Transformer,
         TransformerConfig,
@@ -776,18 +810,7 @@ def bench_packed_transformer(
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
     batch = BATCH_PER_CHIP * n_chips
-    rng = np.random.default_rng(0)
-
-    # Multi30k-shaped ragged corpus: clipped-normal lengths, mean ~15.
-    def ragged(n, vocab, mean=15.0):
-        lens = np.clip(rng.normal(mean, 5.0, n), 4, 60).astype(int)
-        return [list(rng.integers(4, vocab, l)) for l in lens]
-
-    n_pairs = 4096
-    packed = pack_translation_pairs(
-        ragged(n_pairs, SRC_VOCAB), ragged(n_pairs, TRG_VOCAB, 17.0),
-        src_len=SEQ, trg_len=SEQ,
-    )
+    packed = _synthetic_packed_corpus(4096)
     rows = len(packed.src)
     pairs_per_row = packed.pair_count / rows
 
@@ -864,6 +887,148 @@ def bench_packed_transformer(
     }
 
 
+def bench_composed(
+    jax,
+    *,
+    batch_per_chip: int = 512,
+    scan_k: int = 4,
+    trials: int = 4,
+    steps: int = 5,
+    warmup_dispatches: int = 25,
+    n_pairs: int = 65536,
+) -> dict:
+    """Best-achievable record: the three throughput levers COMPOSED on the
+    reference MT model — sequence packing (input density: ~11-12 pairs per
+    200-token row instead of 1), scanned dispatch (``fit(steps_per_call=K)``
+    semantics: K steps per host RPC), and a large batch (MXU tiling +
+    fixed-cost amortization; see docs/tpu_roofline.md). This is the config
+    a real user of the framework would run the reference's Multi30k workload
+    at (``pytorch_machine_translator.py:199-205`` contract); the headline
+    stages keep the reference's own bs=32 per-step shape for comparability,
+    this one records what the framework actually achieves.
+
+    Reported: pairs/sec/chip (the user-meaningful rate), the grid token
+    rate and its MFU (what the chip computes, pad included), and the
+    effective non-pad token rate.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    from machine_learning_apache_spark_tpu.models import (
+        Transformer,
+        TransformerConfig,
+    )
+    from machine_learning_apache_spark_tpu.parallel import (
+        DATA_AXIS,
+        make_mesh,
+        shard_batch_stack,
+    )
+    from machine_learning_apache_spark_tpu.recipes.translation import (
+        make_packed_translation_loss,
+    )
+    from machine_learning_apache_spark_tpu.train.loop import make_multi_step
+    from machine_learning_apache_spark_tpu.train.state import (
+        TrainState,
+        make_optimizer,
+    )
+
+    n_chips = jax.device_count()
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    if not on_tpu:
+        # The composed plan is sized for a v5e (~180 bs-512 steps). On a
+        # CPU smoke run (BENCH_FORCE_TPU_STAGES) that would blow the stage
+        # deadline and quarantine everything after it — shrink to a plan
+        # that exercises the same code path in seconds.
+        batch_per_chip = min(batch_per_chip, 4)
+        scan_k = min(scan_k, 2)
+        trials, steps, warmup_dispatches = 2, 2, 1
+        n_pairs = min(n_pairs, 512)
+    batch = batch_per_chip * n_chips
+    # n_pairs default: enough distinct pairs that the scan stack's rows
+    # don't repeat across the K stacked batches at bs=512.
+    packed = _synthetic_packed_corpus(n_pairs)
+    rows = len(packed.src)
+    pairs_per_row = packed.pair_count / rows
+
+    cfg = TransformerConfig(
+        src_vocab_size=SRC_VOCAB,
+        trg_vocab_size=TRG_VOCAB,
+        max_len=SEQ,
+        num_layers=LAYERS,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = Transformer(cfg)
+    mesh = make_mesh({DATA_AXIS: n_chips})
+
+    host_batches = []
+    for i in range(scan_k):
+        idx = (np.arange(batch) + i * batch) % rows
+        host_batches.append(tuple(a[idx] for a in packed.arrays()))
+    stacked = shard_batch_stack(mesh, host_batches)
+
+    params = model.init(
+        jax.random.key(1),
+        jnp.asarray(packed.src[:2]),
+        jnp.asarray(packed.trg[:2, :-1]),
+    )["params"]
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_optimizer("adam", 1e-3)
+    )
+    multi = make_multi_step(make_packed_translation_loss(model, cfg.pad_id))
+
+    holder = {"state": state, "rng": jax.random.key(2)}
+
+    def one_dispatch():
+        holder["state"], holder["rng"], losses, _ = multi(
+            holder["state"], stacked, holder["rng"]
+        )
+        holder["loss"] = losses[-1]
+
+    for _ in range(warmup_dispatches):
+        one_dispatch()
+    _value_barrier(holder)
+    log(
+        f"composed warmup done (bs/chip={batch_per_chip}, scan_k={scan_k}, "
+        f"{pairs_per_row:.1f} pairs/row, grid use "
+        f"{packed.token_efficiency:.1%}, loss={float(holder['loss']):.3f})"
+    )
+
+    barrier = lambda: _value_barrier(holder)  # noqa: E731
+    times = _time_trials(one_dispatch, trials, steps, barrier)
+    real_steps = steps * scan_k
+    pairs_rate = sorted(
+        batch * pairs_per_row * real_steps / dt / n_chips for dt in times
+    )
+    for dt in times:
+        log(f"composed: {real_steps} steps in {dt:.3f}s → "
+            f"{batch * pairs_per_row * real_steps / dt / n_chips:,.0f} "
+            f"pairs/sec/chip")
+    median_pairs = statistics.median(pairs_rate)
+    median_dt = statistics.median(times)
+    grid_tokens = batch * SEQ * real_steps / median_dt / n_chips
+    flops_step = transformer_train_flops_per_step(batch, SEQ, SEQ - 1, LAYERS)
+    peak = _peak_flops(device)
+    achieved = flops_step * real_steps / median_dt / n_chips
+    mfu = _check_mfu(achieved, peak, "composed")
+    return {
+        "pairs_per_sec_chip": round(median_pairs, 1),
+        "max": round(pairs_rate[-1], 1),
+        "spread": round(pairs_rate[-1] / pairs_rate[0], 2),
+        "grid_tokens_per_sec_chip": round(grid_tokens, 1),
+        "effective_tokens_per_sec_chip": round(
+            grid_tokens * packed.token_efficiency, 1
+        ),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "batch_per_chip": batch_per_chip,
+        "scan_k": scan_k,
+        "steps_per_trial": real_steps,
+        "pairs_per_row": round(pairs_per_row, 2),
+        "token_efficiency": round(packed.token_efficiency, 4),
+        "loss": round(float(holder["loss"]), 3),
+    }
+
+
 def bench_transformer_sweep(
     jax, points: list | None = None, stop_at: float | None = None
 ) -> list[dict]:
@@ -892,6 +1057,9 @@ def bench_transformer_sweep(
             if stop_at is not None and time.monotonic() >= stop_at:
                 log("sweep stopped at its time budget; returning "
                     f"{len(points)} completed points")
+                # Sentinel: marks the list as incomplete so the evidence
+                # recorder won't let it displace a complete committed sweep.
+                points.append({"truncated": "time budget"})
                 return points
             try:
                 r = _with_deadline(
@@ -928,6 +1096,7 @@ def bench_transformer_sweep(
                     # framework (same reasoning as _transient_retry's
                     # fatal-TimeoutError rule).
                     log("sweep quarantined after a hung point")
+                    points.append({"truncated": "hung point"})
                     return points
     return points
 
@@ -1241,6 +1410,33 @@ def main() -> None:
         except Exception as e:
             log(traceback.format_exc())
             result["packed"] = {"error": repr(e)}
+            suspect = suspect or isinstance(e, TimeoutError)
+    if _tpu_stages(jax) and not suspect and not os.environ.get(
+        "BENCH_SKIP_COMPOSED"
+    ):
+        # The three throughput levers composed (packing × scan × bs=512):
+        # the "best achievable tokens/sec/chip" record a real user would
+        # run at, alongside (never replacing) the reference-shape headline.
+        try:
+            comp = _transient_retry(
+                lambda: _with_deadline(
+                    lambda: bench_composed(
+                        jax,
+                        batch_per_chip=int(
+                            os.environ.get("BENCH_COMPOSED_BATCH", "512")
+                        ),
+                        scan_k=int(
+                            os.environ.get("BENCH_COMPOSED_SCAN", "4")
+                        ),
+                    ),
+                    deadline, "composed",
+                ),
+                "composed",
+            )
+            result["composed"] = comp
+        except Exception as e:
+            log(traceback.format_exc())
+            result["composed"] = {"error": repr(e)}
             suspect = suspect or isinstance(e, TimeoutError)
     if _tpu_stages(jax) and not suspect and not os.environ.get(
         "BENCH_SKIP_SWEEP"

@@ -37,6 +37,12 @@ PACKED = {
     "pairs_per_row": 11.5, "token_efficiency": 0.89,
     "unpacked_token_efficiency": 0.08, "loss": 2.0,
 }
+COMPOSED = {
+    "pairs_per_sec_chip": 90000.0, "max": 95000.0, "spread": 1.05,
+    "grid_tokens_per_sec_chip": 1.6e6, "effective_tokens_per_sec_chip": 1.4e6,
+    "mfu": 0.35, "batch_per_chip": 512, "scan_k": 4, "steps_per_trial": 20,
+    "pairs_per_row": 11.5, "token_efficiency": 0.88, "loss": 1.5,
+}
 
 
 @pytest.fixture
@@ -46,6 +52,7 @@ def stage_env(monkeypatch):
     monkeypatch.setattr(bench, "bench_torch_transformer", lambda: 1200.0)
     monkeypatch.setattr(bench, "bench_torch_cnn", lambda: 3000.0)
     monkeypatch.setattr(bench, "bench_cnn", lambda jax: dict(CNN))
+    monkeypatch.setattr(bench, "bench_composed", lambda jax, **kw: dict(COMPOSED))
     return monkeypatch
 
 
@@ -79,6 +86,7 @@ def test_all_stages_merge(stage_env, capsys):
     assert out["packed"]["pairs_per_sec_chip"] == 30000.0
     # 600000/200 = 3000 pairs/s unpacked ceiling → 10x
     assert out["packed"]["vs_unpacked_pairs_rate"] == 10.0
+    assert out["composed"]["pairs_per_sec_chip"] == 90000.0
     assert out["sweep"][0]["batch_per_chip"] == 128
     assert out["cnn"]["vs_baseline"] == round(1000000.0 / 3000.0, 3)
     assert "after_timeout" not in out["cnn"]
@@ -89,10 +97,14 @@ def test_headline_timeout_quarantines_later_stages(stage_env, capsys):
         raise TimeoutError("transformer deadline (900s) exceeded")
 
     stage_env.setattr(bench, "bench_transformer", hung)
-    called = {"packed": 0, "sweep": 0}
+    called = {"packed": 0, "sweep": 0, "composed": 0}
     stage_env.setattr(
         bench, "bench_packed_transformer",
         lambda jax, **kw: called.__setitem__("packed", 1) or dict(PACKED),
+    )
+    stage_env.setattr(
+        bench, "bench_composed",
+        lambda jax, **kw: called.__setitem__("composed", 1) or dict(COMPOSED),
     )
     stage_env.setattr(
         bench, "bench_transformer_sweep",
@@ -100,7 +112,7 @@ def test_headline_timeout_quarantines_later_stages(stage_env, capsys):
     )
     out = _run_main(capsys)
     assert "TimeoutError" in out["error"]
-    assert called == {"packed": 0, "sweep": 0}  # skipped, not run
+    assert called == {"packed": 0, "sweep": 0, "composed": 0}  # skipped
     assert "scanned" not in out
     # CNN kept for artifact completeness but flagged untrustworthy.
     assert out["cnn"]["after_timeout"] is True
@@ -132,9 +144,11 @@ def test_record_tpu_evidence_roundtrip(tmp_path, monkeypatch):
     result = dict(MT)
     result["scanned"] = {"median": 900000.0, "scan_k": 8}
     result["packed"] = dict(PACKED)
+    result["composed"] = dict(COMPOSED)
     result["cnn"] = dict(CNN)
     bench._record_tpu_evidence(result)
     ev = bench._load_tpu_evidence()
+    assert ev["composed"]["pairs_per_sec_chip"] == 90000.0
     assert ev["transformer"]["median_tokens_per_sec_chip"] == 600000.0
     assert ev["transformer"]["paired_window_steady_state"][
         "tokens_per_sec_chip"
@@ -172,6 +186,19 @@ def test_record_skips_failed_stages(tmp_path, monkeypatch):
     ev = bench._load_tpu_evidence()
     assert "packed" not in ev
     assert "sweep" not in ev  # partial sweep must not look complete
+    # A time-budget-truncated sweep (sentinel appended by the sweep loop,
+    # no sweep_error) must not displace a complete committed record either.
+    full = dict(MT)
+    full["sweep"] = [{"batch_per_chip": 128, "layers": 1, "mfu": 0.2}]
+    bench._record_tpu_evidence(full)
+    trunc = dict(MT)
+    trunc["sweep"] = [
+        {"batch_per_chip": 128, "layers": 1, "mfu": 0.1},
+        {"truncated": "time budget"},
+    ]
+    bench._record_tpu_evidence(trunc)
+    ev = bench._load_tpu_evidence()
+    assert ev["sweep"] == [{"batch_per_chip": 128, "layers": 1, "mfu": 0.2}]
     before = path.read_text()
     bench._record_tpu_evidence({"error": "boom", "cnn": {"error": "x"}})
     assert path.read_text() == before  # nothing measured → keep old record

@@ -1,0 +1,77 @@
+#!/bin/bash
+# One-stop TPU capture session. Probes the axon tunnel in a loop; on the
+# first successful probe runs, in order, on the live chip:
+#   1. full bench.py            -> BENCH_SELF_r05.json/.log
+#   2. short bench re-run       -> BENCH_SELF_r05_cachecheck.log
+#      (fresh process, same programs: its warmup time vs run 1's validates
+#      the persistent XLA compile cache against the axon backend)
+#   3. tools/longctx_bench.py   -> LONGCTX_r05.json/.log (seq 2048/4096/8192)
+#   4. tools/examples_sweep.py  -> EXAMPLES_TPU_r05.log (entry points on TPU)
+# Any step producing a CPU-fallback artifact sends the loop back to probing
+# (tunnel died between probe and launch); steps 2-4 are best-effort and
+# never block the loop's exit once step 1 has a TPU artifact.
+cd /root/repo || exit 1
+note() { echo "$(date -Is) $*" >> /tmp/tpu_watch.out; }
+while true; do
+  if timeout 120 python - <<'EOF' >/tmp/tpu_probe.log 2>&1
+import os
+os.environ['JAX_PLATFORMS'] = 'axon'
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128))
+print(float((x @ x).sum()), jax.devices())
+EOF
+  then
+    date -Is > /tmp/tpu_alive
+    note "tunnel alive — step 1: full bench"
+    # Outer timeout: BENCH_PLATFORM=axon skips the subprocess probe, so a
+    # hang during backend INIT (before any workload deadline arms) would
+    # otherwise wedge forever.
+    BENCH_ROUND=r05 BENCH_PLATFORM=axon timeout 5400 python bench.py \
+      > BENCH_SELF_r05.json 2> BENCH_SELF_r05.log
+    rc=$?
+    if ! python - BENCH_SELF_r05.json BENCH_SELF_r05.log <<'EOF'
+import json, sys
+try:
+    r = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)  # no parseable artifact (e.g. killed by the outer timeout)
+if "tpu" in str(r.get("device", "")).lower():
+    sys.exit(0)
+# The device field only lands when the headline stage succeeds; a run
+# whose headline errored but whose other stages measured on chip is still
+# a TPU run. The CPU-fallback markers in the log are the ground truth.
+try:
+    log_text = open(sys.argv[2]).read()
+except Exception:
+    sys.exit(1)
+fell_back = "falling back to CPU" in log_text or "non-TPU backend" in log_text
+sys.exit(1 if fell_back else 0)
+EOF
+    then
+      note "bench rc=$rc but artifact not TPU — reprobing"
+      sleep 60
+      continue
+    fi
+    note "step 1 done rc=$rc (TPU artifact)"
+    note "step 2: cache-check re-run (headline only, short)"
+    BENCH_ROUND=r05 BENCH_PLATFORM=axon BENCH_TRIALS=2 BENCH_TPU_STEPS=20 \
+      BENCH_SKIP_SCANNED=1 BENCH_SKIP_PACKED=1 BENCH_SKIP_COMPOSED=1 \
+      BENCH_SKIP_SWEEP=1 BENCH_SKIP_TORCH=1 BENCH_CNN_TRIALS=1 \
+      timeout 1200 python bench.py \
+      > /tmp/bench_cachecheck.json 2> BENCH_SELF_r05_cachecheck.log
+    note "step 2 done rc=$? (compare 'warmup done' timestamps in the logs)"
+    note "step 3: long-context bench"
+    JAX_PLATFORMS=axon timeout 2400 python tools/longctx_bench.py \
+      > LONGCTX_r05.json 2> LONGCTX_r05.log
+    note "step 3 done rc=$?"
+    note "step 4: examples sweep on TPU"
+    timeout 3600 python tools/examples_sweep.py --platform default \
+      > EXAMPLES_TPU_r05.log 2>&1
+    note "step 4 done rc=$?"
+    note "capture session complete"
+    exit 0
+  else
+    date -Is > /tmp/tpu_dead
+    sleep 120
+  fi
+done
