@@ -21,6 +21,7 @@ from machine_learning_apache_spark_tpu.ops.masks import (
 )
 from machine_learning_apache_spark_tpu.ops.positional import sinusoidal_encoding
 from machine_learning_apache_spark_tpu.ops.attention import (
+    attention_impl,
     dot_product_attention,
     scaled_dot_product_attention,
     multi_head_attention_weights,
@@ -28,6 +29,7 @@ from machine_learning_apache_spark_tpu.ops.attention import (
 )
 
 __all__ = [
+    "attention_impl",
     "dot_product_attention",
     "make_causal_mask",
     "make_padding_mask",

@@ -78,6 +78,32 @@ def _active_seq_mesh():
     return _SEQ_PARALLEL_CTX[-1] if _SEQ_PARALLEL_CTX else None
 
 
+# Forced implementation override for ``dot_product_attention``'s auto
+# dispatch (a stack so contexts nest). None = auto (flash on TPU for
+# structured masks, dense-XLA otherwise).
+_FORCED_IMPL: list[str] = []
+
+
+@contextlib.contextmanager
+def attention_impl(impl: str):
+    """Pin the structured-mask attention implementation inside the block:
+    ``"dense"`` (materialized-[Sq,Sk] XLA path) or ``"flash"`` (blockwise
+    Pallas kernel). Benchmarking/debugging hook — e.g. the long-context
+    bench measures the flash kernel against the dense path it replaces
+    (the reference's ``transformer.py:12-25`` core) at each sequence
+    length. Sites the override cannot serve keep their rules: dense-mask
+    calls never go to flash, and an active ``sequence_parallel`` context
+    still wins.
+    """
+    if impl not in ("dense", "flash"):
+        raise ValueError(f"impl must be 'dense' or 'flash', got {impl!r}")
+    _FORCED_IMPL.append(impl)
+    try:
+        yield
+    finally:
+        _FORCED_IMPL.pop()
+
+
 def multi_head_attention_weights(
     query: jnp.ndarray,
     key: jnp.ndarray,
@@ -190,7 +216,10 @@ def dot_product_attention(
             seq_axis=seq_axis, batch_axis=batch_axis,
         )
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu" and mask is None
+        if _FORCED_IMPL:
+            use_pallas = _FORCED_IMPL[-1] == "flash" and mask is None
+        else:
+            use_pallas = jax.default_backend() == "tpu" and mask is None
     if use_pallas and mask is None:
         from machine_learning_apache_spark_tpu.ops.pallas_attention import (
             flash_attention,

@@ -147,6 +147,79 @@ class TestAttention:
         np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, atol=1e-5)
 
 
+class TestAttentionImplOverride:
+    """``ops.attention_impl``: the benchmarking hook that pins auto
+    dispatch to the dense or flash path (the long-context bench measures
+    the Pallas kernel against the dense core it replaces with it)."""
+
+    def _spy(self, monkeypatch):
+        import machine_learning_apache_spark_tpu.ops.pallas_attention as pa
+
+        calls = []
+
+        def fake_flash(q, k, v, **kw):
+            calls.append(kw)
+            return scaled_dot_product_attention(q, k, v)
+
+        monkeypatch.setattr(pa, "flash_attention", fake_flash)
+        return calls
+
+    def test_forced_flash_dispatches_to_kernel(self, rng, monkeypatch):
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            attention_impl,
+            dot_product_attention,
+        )
+
+        calls = self._spy(monkeypatch)
+        q = jnp.asarray(rng.standard_normal((1, 2, 8, 4)), dtype=jnp.float32)
+        dot_product_attention(q, q, q, causal=True)  # auto on CPU → dense
+        assert calls == []
+        with attention_impl("flash"):
+            dot_product_attention(q, q, q, causal=True)
+        assert len(calls) == 1
+        # Context restored: auto again.
+        dot_product_attention(q, q, q, causal=True)
+        assert len(calls) == 1
+
+    def test_forced_dense_and_explicit_arg_wins(self, rng, monkeypatch):
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            attention_impl,
+            dot_product_attention,
+        )
+
+        calls = self._spy(monkeypatch)
+        q = jnp.asarray(rng.standard_normal((1, 2, 8, 4)), dtype=jnp.float32)
+        with attention_impl("dense"):
+            dot_product_attention(q, q, q, causal=True)
+            assert calls == []
+            # An explicit use_pallas argument overrides the context.
+            dot_product_attention(q, q, q, causal=True, use_pallas=True)
+            assert len(calls) == 1
+
+    def test_dense_mask_never_flash(self, rng, monkeypatch):
+        # A dense mask cannot stream through the blockwise kernel — the
+        # forced-flash context must not break that invariant.
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            attention_impl,
+            dot_product_attention,
+        )
+
+        calls = self._spy(monkeypatch)
+        q = jnp.asarray(rng.standard_normal((1, 2, 8, 4)), dtype=jnp.float32)
+        with attention_impl("flash"):
+            dot_product_attention(q, q, q, mask=make_causal_mask(8))
+        assert calls == []
+
+    def test_bad_impl_rejected(self):
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            attention_impl,
+        )
+
+        with pytest.raises(ValueError, match="dense.*flash|flash.*dense"):
+            with attention_impl("fast"):
+                pass
+
+
 class TestFlashAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_xla_path(self, rng, causal):

@@ -61,7 +61,10 @@ EOF
       > /tmp/bench_cachecheck.json 2> BENCH_SELF_r05_cachecheck.log
     note "step 2 done rc=$? (compare 'warmup done' timestamps in the logs)"
     note "step 3: long-context bench"
-    JAX_PLATFORMS=axon timeout 2400 python tools/longctx_bench.py \
+    # Budget: 6 (seq, impl) configs x 600s per-config deadline + compile
+    # slack; the outer timeout is the backstop for a hang during backend
+    # init, not the scheduler for healthy configs.
+    JAX_PLATFORMS=axon timeout 4500 python tools/longctx_bench.py \
       > LONGCTX_r05.json 2> LONGCTX_r05.log
     note "step 3 done rc=$?"
     note "step 4: examples sweep on TPU"
