@@ -180,12 +180,18 @@ def test_record_skips_failed_stages(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "_EVIDENCE_PATH", str(path))
     ok = dict(MT)
     ok["packed"] = {"error": "TimeoutError(...)"}
+    ok["composed"] = {"skipped": "total budget"}
     ok["sweep"] = [{"batch_per_chip": 128, "layers": 1}]  # salvage list...
     ok["sweep_error"] = "ValueError('mid-sweep crash')"  # ...from a crash
     bench._record_tpu_evidence(ok)
     ev = bench._load_tpu_evidence()
     assert "packed" not in ev
+    assert "composed" not in ev  # budget skip is not a measurement
     assert "sweep" not in ev  # partial sweep must not look complete
+    skip_sweep = dict(MT)
+    skip_sweep["sweep"] = {"skipped": "total budget"}
+    bench._record_tpu_evidence(skip_sweep)
+    assert "sweep" not in bench._load_tpu_evidence()
     # A time-budget-truncated sweep (sentinel appended by the sweep loop,
     # no sweep_error) must not displace a complete committed record either.
     full = dict(MT)
@@ -202,6 +208,38 @@ def test_record_skips_failed_stages(tmp_path, monkeypatch):
     before = path.read_text()
     bench._record_tpu_evidence({"error": "boom", "cnn": {"error": "x"}})
     assert path.read_text() == before  # nothing measured → keep old record
+
+
+def test_total_budget_skips_optional_stages_keeps_cnn(stage_env, capsys):
+    """With the total-run ledger exhausted, optional stages are recorded as
+    skipped (not silently absent, never stamped into the evidence record)
+    while the headline and CNN still run — a partial artifact always beats
+    none."""
+    stage_env.setenv("BENCH_TOTAL_BUDGET", "0")
+    called = {"scanned": 0}
+
+    def mt(jax, **kw):
+        if kw.get("scan_k"):
+            called["scanned"] = 1
+        return dict(MT)
+
+    stage_env.setattr(bench, "bench_transformer", mt)
+    stage_env.setattr(
+        bench, "bench_packed_transformer", lambda jax, **kw: dict(PACKED)
+    )
+    stage_env.setattr(
+        bench, "bench_transformer_sweep",
+        lambda jax, points=None, stop_at=None: [],
+    )
+    out = _run_main(capsys)
+    assert out["value"] == 600000.0  # headline still ran (its own deadline)
+    assert out["scanned"] == {"skipped": "total budget"}
+    assert called["scanned"] == 0
+    assert out["packed"] == {"skipped": "total budget"}
+    assert out["composed"] == {"skipped": "total budget"}
+    assert out["sweep"] == {"skipped": "total budget"}
+    assert "sweep_error" not in out  # a deliberate skip is not a failure
+    assert out["cnn"]["value"] == 1000000.0  # reserve spent on the CNN
 
 
 def test_stage_failure_does_not_void_others(stage_env, capsys):

@@ -13,7 +13,10 @@
 cd /root/repo || exit 1
 note() { echo "$(date -Is) $*" >> /tmp/tpu_watch.out; }
 while true; do
-  if timeout 120 python - <<'EOF' >/tmp/tpu_probe.log 2>&1
+  # 240s: a LIVE tunnel's init+first-compile has measured ~90s from cold,
+  # and a dead one hangs forever — a 120s timeout risks misclassifying a
+  # sluggish-but-alive tunnel on exactly the probe that mattered.
+  if timeout 240 python - <<'EOF' >/tmp/tpu_probe.log 2>&1
 import os
 os.environ['JAX_PLATFORMS'] = 'axon'
 import jax, jax.numpy as jnp
@@ -26,7 +29,11 @@ EOF
     # Outer timeout: BENCH_PLATFORM=axon skips the subprocess probe, so a
     # hang during backend INIT (before any workload deadline arms) would
     # otherwise wedge forever.
-    BENCH_ROUND=r05 BENCH_PLATFORM=axon timeout 5400 python bench.py \
+    # Dedicated capture window: allow the full plan (the in-bench ledger
+    # defaults to 2700s to protect harness-invoked runs; here the outer
+    # timeout is the only ceiling).
+    BENCH_ROUND=r05 BENCH_PLATFORM=axon BENCH_TOTAL_BUDGET=4800 \
+      timeout 5400 python bench.py \
       > BENCH_SELF_r05.json 2> BENCH_SELF_r05.log
     rc=$?
     if ! python - BENCH_SELF_r05.json BENCH_SELF_r05.log <<'EOF'
