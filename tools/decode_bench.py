@@ -1,0 +1,153 @@
+"""Inference decode throughput on the reference MT model shapes.
+
+The reference ships no inference path at all (SURVEY.md C23: its
+``Transformer`` stops at training); this framework adds KV-cache greedy,
+sampling, and flat-batched beam decoding. This tool measures them on chip:
+
+- ``greedy_cached`` — O(1) decoder work per token (the product decode path)
+- ``beam4`` — beam_size=4 flat-batched beams sharing one cache
+- ``greedy_naive`` — the O(L) full re-decode (``greedy_translate``), the
+  baseline that quantifies what the cache buys
+
+Metric: NEW tokens/sec/chip (generated tokens only, ``B × max_new`` per
+call). Median of TRIALS timed windows, spread alongside, every workload
+under a deadline (bench.py's tunnel discipline). Run on a live TPU:
+``python tools/decode_bench.py``; ``--cpu`` runs a tiny-shape smoke of the
+same code path. One JSON line per decoder plus a summary line.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def main() -> None:
+    smoke = "--cpu" in sys.argv
+    if smoke:
+        # Force the CPU backend BEFORE init: a smoke run must never land
+        # on the chip (it could interleave with a live capture session's
+        # timed windows), whatever the tunnel state.
+        os.environ["BENCH_PLATFORM"] = "cpu"
+    jax = bench._init_backend()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu and not smoke:
+        print(json.dumps({"error": "needs the live TPU chip (or --cpu)"}))
+        return
+
+    import jax.numpy as jnp
+
+    from machine_learning_apache_spark_tpu.models import (
+        Transformer,
+        TransformerConfig,
+    )
+    from machine_learning_apache_spark_tpu.models.transformer import (
+        beam_translate,
+        greedy_translate,
+        greedy_translate_cached,
+    )
+
+    if smoke and not on_tpu:
+        bs, src_len, max_new, trials, calls, warmup = 4, 8, 8, 2, 1, 1
+        cfg = TransformerConfig(
+            src_vocab_size=64, trg_vocab_size=64, d_model=32, ffn_hidden=64,
+            num_heads=2, num_layers=1, max_len=32, dropout=0.0,
+        )
+    else:
+        bs = int(os.environ.get("DECODE_BATCH", "64"))
+        src_len, max_new = 32, 64
+        trials, calls, warmup = 5, 4, 3
+        cfg = TransformerConfig(
+            src_vocab_size=bench.SRC_VOCAB,
+            trg_vocab_size=bench.TRG_VOCAB,
+            max_len=bench.SEQ,
+            num_layers=bench.LAYERS,
+            dropout=0.0,
+            dtype=jnp.bfloat16,
+        )
+    model = Transformer(cfg)
+    src = jax.random.randint(
+        jax.random.key(0), (bs, src_len), 3, cfg.src_vocab_size,
+        dtype=jnp.int32,
+    )
+    params = model.init(jax.random.key(1), src[:2], src[:2])["params"]
+
+    decoders = {
+        "greedy_cached": jax.jit(
+            lambda p, s: greedy_translate_cached(
+                model, p, s, max_new_tokens=max_new
+            )
+        ),
+        "beam4": jax.jit(
+            lambda p, s: beam_translate(
+                model, p, s, beam_size=4, max_new_tokens=max_new
+            )
+        ),
+        "greedy_naive": jax.jit(
+            lambda p, s: greedy_translate(
+                model, p, s, max_new_tokens=max_new
+            )
+        ),
+    }
+
+    results = {}
+    for name, fn in decoders.items():
+        try:
+            def measure():
+                out = fn(params, src)
+                out.block_until_ready()
+                # Value fetch: the only barrier the tunnel relay can't ack
+                # early (see bench._value_barrier).
+                float(out[0, -1])
+                for _ in range(warmup):
+                    float(fn(params, src)[0, -1])
+                times = []
+                for _ in range(trials):
+                    t0 = time.perf_counter()
+                    for _ in range(calls):
+                        out = fn(params, src)
+                    float(out[0, -1])
+                    times.append(time.perf_counter() - t0)
+                rates = sorted(bs * max_new * calls / t for t in times)
+                return {
+                    "new_tokens_per_sec_chip": round(
+                        statistics.median(rates), 1
+                    ),
+                    "max": round(rates[-1], 1),
+                    "spread": round(rates[-1] / rates[0], 2)
+                    if rates[0] else None,
+                    "batch": bs,
+                    "max_new_tokens": max_new,
+                }
+
+            r = bench._with_deadline(measure, 600, f"decode {name}")
+        except Exception as e:  # noqa: BLE001 — record and continue
+            r = {"error": repr(e)}
+        results[name] = r
+        print(json.dumps({"decoder": name, **r}), flush=True)
+        if "error" in r and "TimeoutError" in r["error"]:
+            print(json.dumps({"stopped": "device quarantined after a "
+                              "hung decoder"}), flush=True)
+            return
+    summary = {}
+    gc = results.get("greedy_cached", {}).get("new_tokens_per_sec_chip")
+    gn = results.get("greedy_naive", {}).get("new_tokens_per_sec_chip")
+    if gc and gn:
+        summary["cache_speedup_vs_naive"] = round(gc / gn, 2)
+    b4 = results.get("beam4", {}).get("new_tokens_per_sec_chip")
+    if gc and b4:
+        # Raw emitted-tokens slowdown of beam-4 vs greedy. Each beam row
+        # also decodes 4 hypotheses internally, so the per-hypothesis
+        # cost is this divided by 4 — reported separately.
+        summary["beam4_cost_vs_greedy"] = round(gc / b4, 2)
+        summary["beam4_cost_per_hypothesis"] = round(gc / (4 * b4), 2)
+    print(json.dumps({"summary": summary}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -78,6 +78,10 @@ EOF
     timeout 3600 python tools/examples_sweep.py --platform default \
       > EXAMPLES_TPU_r05.log 2>&1
     note "step 4 done rc=$?"
+    note "step 5: decode throughput bench"
+    JAX_PLATFORMS=axon timeout 2400 python tools/decode_bench.py \
+      > DECODE_r05.json 2> DECODE_r05.log
+    note "step 5 done rc=$?"
     note "capture session complete"
     exit 0
   else
