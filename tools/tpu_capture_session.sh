@@ -75,8 +75,10 @@ EOF
       > LONGCTX_r05.json 2> LONGCTX_r05.log
     note "step 3 done rc=$?"
     note "step 4: examples sweep on TPU"
+    # 300s per example (compile ~20-40s + seconds of train) so one hung
+    # tunnel RPC can't eat the whole step's outer timeout.
     timeout 3600 python tools/examples_sweep.py --platform default \
-      > EXAMPLES_TPU_r05.log 2>&1
+      --timeout 300 > EXAMPLES_TPU_r05.log 2>&1
     note "step 4 done rc=$?"
     note "step 5: decode throughput bench"
     JAX_PLATFORMS=axon timeout 2400 python tools/decode_bench.py \
