@@ -1,0 +1,146 @@
+"""Render the r05 capture artifacts as PARITY-ready markdown.
+
+Reads whichever of BENCH_SELF_r05.json / LONGCTX_r05.json / DECODE_r05.json
+exist at the repo root (plus the cache-check log pair) and prints a
+markdown fragment with one table row per measured stage — medians, spread,
+MFU, protocol — so the post-capture commit is a paste, not a transcription.
+Purely read-only; safe to run any time.
+"""
+
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    try:
+        with open(os.path.join(ROOT, name)) as f:
+            text = f.read().strip()
+        if not text:
+            return None
+        if name.endswith(".json") and "\n" in text:
+            return [json.loads(line) for line in text.splitlines()]
+        return json.loads(text)
+    except Exception as e:  # noqa: BLE001
+        print(f"<!-- {name}: unreadable ({e!r}) -->")
+        return None
+
+
+def fmt(x, nd=0):
+    if x is None:
+        return "—"
+    return f"{x:,.{nd}f}"
+
+
+def main() -> None:
+    b = _load("BENCH_SELF_r05.json")
+    out = []
+    if isinstance(b, dict):
+        dev = b.get("device", "?")
+        out.append(f"### Bench (device: {dev})\n")
+        out.append("| stage | rate | spread | MFU | protocol |")
+        out.append("|---|---|---|---|---|")
+        if b.get("median"):
+            pw = b.get("paired_window", {})
+            out.append(
+                f"| MT headline (bs={b.get('batch_per_chip')}, "
+                f"L={b.get('layers')}) | **{fmt(b['median'])} tok/s/chip** "
+                f"(steady-state {fmt(pw.get('steady_state_rate'))}) "
+                f"| {b.get('spread')} | {b.get('mfu')} "
+                f"(steady {pw.get('steady_state_mfu', '—')}) "
+                f"| {b.get('steps_per_trial')}-step windows, "
+                f"setup+warmup {b.get('setup_plus_warmup_s', '?')}s |"
+            )
+        sc = b.get("scanned") or {}
+        if sc.get("median"):
+            out.append(
+                f"| MT scanned (K={sc.get('scan_k')}) | "
+                f"**{fmt(sc['median'])} tok/s/chip** | {sc.get('spread')} "
+                f"| {sc.get('mfu')} | {sc.get('steps_per_trial')} steps/trial |"
+            )
+        pk = b.get("packed") or {}
+        if pk.get("pairs_per_sec_chip"):
+            out.append(
+                f"| MT packed | **{fmt(pk['pairs_per_sec_chip'])} "
+                f"pairs/s/chip** ({pk.get('vs_unpacked_pairs_rate', '—')}× "
+                f"unpacked ceiling) | {pk.get('spread')} | — | "
+                f"{pk.get('pairs_per_row')} pairs/row, grid use "
+                f"{pk.get('token_efficiency')} |"
+            )
+        co = b.get("composed") or {}
+        if co.get("pairs_per_sec_chip"):
+            out.append(
+                f"| MT composed (packed×scan K={co.get('scan_k')}"
+                f"×bs={co.get('batch_per_chip')}) | "
+                f"**{fmt(co['pairs_per_sec_chip'])} pairs/s/chip** "
+                f"(effective {fmt(co.get('effective_tokens_per_sec_chip'))} "
+                f"tok/s) | {co.get('spread')} | {co.get('mfu')} (grid) | "
+                f"{co.get('steps_per_trial')} steps/trial |"
+            )
+        cnn = b.get("cnn") or {}
+        if cnn.get("median"):
+            out.append(
+                f"| CNN scanned (K={cnn.get('scan_k')}) | "
+                f"**{fmt(cnn['median'])} samples/s/chip** | "
+                f"{cnn.get('spread')} | {cnn.get('mfu')} | "
+                f"{cnn.get('steps_per_trial')} steps/trial |"
+            )
+        sweep = b.get("sweep")
+        if isinstance(sweep, list) and sweep:
+            out.append("\n### Sweep (upgraded protocol)\n")
+            out.append("| bs/chip | layers | tok/s/chip | MFU | steady MFU | spread |")
+            out.append("|---|---|---|---|---|---|")
+            for p in sweep:
+                if not isinstance(p, dict) or "error" in p or "truncated" in p:
+                    continue
+                out.append(
+                    f"| {p.get('batch_per_chip')} | {p.get('layers')} | "
+                    f"{fmt(p.get('tokens_per_sec_chip'))} | {p.get('mfu')} "
+                    f"| {p.get('steady_state_mfu', '—')} | {p.get('spread')} |"
+                )
+    lc = _load("LONGCTX_r05.json")
+    if isinstance(lc, list):
+        out.append("\n### Long context (flash vs dense)\n")
+        out.append("| seq | impl | tok/s/chip | MFU | spread | note |")
+        out.append("|---|---|---|---|---|---|")
+        for r in lc:
+            if "summary" in r or "stopped" in r:
+                continue
+            note = "OOM" if r.get("oom") else ("error" if "error" in r else "")
+            out.append(
+                f"| {r.get('seq')} | {r.get('impl')} | "
+                f"{fmt(r.get('tokens_per_sec_chip'))} | {r.get('mfu', '—')} "
+                f"| {r.get('spread', '—')} | {note} |"
+            )
+        for r in lc:
+            if "summary" in r:
+                out.append(f"\nSummary: `{json.dumps(r['summary'])}`")
+    dc = _load("DECODE_r05.json")
+    if isinstance(dc, list):
+        out.append("\n### Decode throughput\n")
+        out.append("| decoder | new tok/s/chip | spread |")
+        out.append("|---|---|---|")
+        for r in dc:
+            if "decoder" in r and "new_tokens_per_sec_chip" in r:
+                out.append(
+                    f"| {r['decoder']} | {fmt(r['new_tokens_per_sec_chip'])} "
+                    f"| {r.get('spread')} |"
+                )
+            if "summary" in r:
+                out.append(f"\nSummary: `{json.dumps(r['summary'])}`")
+    # Cache-check: compare setup+warmup between the main and re-run logs.
+    for name in ("BENCH_SELF_r05.log", "BENCH_SELF_r05_cachecheck.log"):
+        path = os.path.join(ROOT, name)
+        if os.path.exists(path):
+            with open(path) as f:
+                m = re.findall(r"setup\+warmup ([0-9.]+)s", f.read())
+            if m:
+                out.append(f"\n<!-- {name}: setup+warmup {m[0]}s -->")
+    print("\n".join(out) if out else "<!-- no capture artifacts found -->")
+
+
+if __name__ == "__main__":
+    main()
