@@ -29,11 +29,12 @@ EOF
     # Outer timeout: BENCH_PLATFORM=axon skips the subprocess probe, so a
     # hang during backend INIT (before any workload deadline arms) would
     # otherwise wedge forever.
-    # Dedicated capture window: allow the full plan (the in-bench ledger
-    # defaults to 2700s to protect harness-invoked runs; here the outer
-    # timeout is the only ceiling).
-    BENCH_ROUND=r05 BENCH_PLATFORM=axon BENCH_TOTAL_BUDGET=4800 \
-      timeout 5400 python bench.py \
+    # Budget sized to the observed alive-window scale (round 4's was ~47
+    # min): the bench self-paces to ~45 min so one window can also fit the
+    # long-context and decode steps; stage order already puts the headline
+    # first and the sweep last.
+    BENCH_ROUND=r05 BENCH_PLATFORM=axon BENCH_TOTAL_BUDGET=2700 \
+      timeout 3600 python bench.py \
       > BENCH_SELF_r05.json 2> BENCH_SELF_r05.log
     rc=$?
     if ! python - BENCH_SELF_r05.json BENCH_SELF_r05.log <<'EOF'
@@ -78,7 +79,7 @@ EOF
     # 300s per example (compile ~20-40s + seconds of train) so one hung
     # tunnel RPC can't eat the whole step's outer timeout.
     timeout 3600 python tools/examples_sweep.py --platform default \
-      --timeout 300 > EXAMPLES_TPU_r05.log 2>&1
+      --timeout 420 > EXAMPLES_TPU_r05.log 2>&1
     note "step 4 done rc=$?"
     note "step 5: decode throughput bench"
     JAX_PLATFORMS=axon timeout 2400 python tools/decode_bench.py \
