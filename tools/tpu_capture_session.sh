@@ -86,6 +86,10 @@ EOF
       > DECODE_r05.json 2> DECODE_r05.log
     note "step 5 done rc=$?"
     note "capture session complete"
+    # Tells the supervisor loop (tools/tpu_capture_supervisor.sh) not to
+    # relaunch: a completed capture must not re-run into the judge's own
+    # end-of-round bench window.
+    date -Is > /tmp/capture_done
     exit 0
   else
     date -Is > /tmp/tpu_dead
