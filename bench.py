@@ -459,16 +459,35 @@ def _record_tpu_evidence(result: dict) -> None:
                 "mfu": pw.get("steady_state_mfu"),
             }
     for key in ("scanned", "packed", "composed", "sweep"):
-        if key == "sweep" and (
-            result.get("sweep_error")
-            or any(
-                "error" in p or "truncated" in p
-                for p in result.get("sweep") or []
+        if key == "sweep":
+            # Per-(batch, layers) merge: only the rows that measured
+            # cleanly bank; error/truncated rows from a hang cost that
+            # point, never the rows that landed — neither this run's nor
+            # an earlier window's (a BENCH_SWEEP_POINTS re-capture of the
+            # stolen points must not re-measure the survivors).
+            rows = [
+                p for p in result.get("sweep") or []
                 if isinstance(p, dict)
+                and "error" not in p and "truncated" not in p
+            ]
+            if not rows:
+                continue
+            stamped.append(key)
+            merged = {
+                (p.get("batch_per_chip"), p.get("layers")): p
+                for p in (ev.get(key) or [])
+                if isinstance(p, dict)
+                and "error" not in p and "truncated" not in p
+            }
+            merged.update({
+                (p.get("batch_per_chip"), p.get("layers")): p for p in rows
+            })
+            ev[key] = sorted(
+                merged.values(),
+                key=lambda p: (p.get("layers") or 0,
+                               p.get("batch_per_chip") or 0),
             )
-        ):
-            continue  # partial sweep must not erase the last complete one
-        if result.get(key) and not (
+        elif result.get(key) and not (
             isinstance(result[key], dict)
             and (result[key].get("error") or result[key].get("skipped"))
         ):
@@ -1062,56 +1081,77 @@ def bench_transformer_sweep(
     """
     points = [] if points is None else points
     point_deadline = float(os.environ.get("BENCH_SWEEP_POINT_DEADLINE", "300"))
-    for layers in (1, 4):
-        for bpc in (32, 128, 256, 512):
-            if layers == 4 and bpc == 512:
-                continue  # ~50s/trial window; the surface is clear by then
-            if bpc == BATCH_PER_CHIP and layers == LAYERS:
-                continue  # the headline run already measured this point
-            if stop_at is not None and time.monotonic() >= stop_at:
-                log("sweep stopped at its time budget; returning "
-                    f"{len(points)} completed points")
-                # Sentinel: marks the list as incomplete so the evidence
-                # recorder won't let it displace a complete committed sweep.
-                points.append({"truncated": "time budget"})
-                return points
+    # BENCH_SWEEP_POINTS="32x4,128x4" makes the plan exactly those
+    # (batch_per_chip x layers) points, in order — chip windows through the
+    # tunnel are scarce, and a re-capture of points a hang stole must not
+    # spend its window re-measuring the ones that already landed.
+    only_env = os.environ.get("BENCH_SWEEP_POINTS", "").strip()
+    if only_env:
+        # Tolerant parse: a typo'd token must cost that token, not the
+        # whole sweep stage of a scarce chip window.
+        plan = []
+        for tok in only_env.split(","):
             try:
-                r = _with_deadline(
-                    lambda: bench_transformer(
-                        jax, batch_per_chip=bpc, layers=layers,
-                        trials=2, steps=10, warmup=5,
-                    ),
-                    point_deadline,
-                    f"sweep bs={bpc} L={layers}",
-                )
-                points.append({
-                    "batch_per_chip": bpc,
-                    "layers": layers,
-                    "tokens_per_sec_chip": r["median"],
-                    "mfu": r["mfu"],
-                    "spread": r["spread"],
-                    "steady_state_mfu": r.get("paired_window", {}).get(
-                        "steady_state_mfu"
-                    ),
-                })
-                log(
-                    f"sweep bs/chip={bpc} layers={layers}: "
-                    f"{r['median']:,.0f} tok/s/chip, mfu={r['mfu']}"
-                )
-            except Exception as e:
-                log(f"sweep point bs={bpc} layers={layers} failed: {e!r}")
-                points.append({
-                    "batch_per_chip": bpc, "layers": layers, "error": repr(e),
-                })
-                if isinstance(e, TimeoutError):
-                    # Single strike: the abandoned thread may STILL be
-                    # executing on the chip once its RPC un-wedges — any
-                    # further point would measure contention, not the
-                    # framework (same reasoning as _transient_retry's
-                    # fatal-TimeoutError rule).
-                    log("sweep quarantined after a hung point")
-                    points.append({"truncated": "hung point"})
-                    return points
+                b, l = tok.strip().lower().split("x")
+                plan.append((int(b), int(l)))
+            except ValueError:
+                if tok.strip():
+                    log(f"BENCH_SWEEP_POINTS: skipping malformed {tok!r}")
+    else:
+        plan = [
+            (bpc, layers)
+            for layers in (1, 4)
+            for bpc in (32, 128, 256, 512)
+            # 512x4 is ~50s/trial; the surface is clear by then. The
+            # headline config is already measured by its own stage.
+            if not (layers == 4 and bpc == 512)
+            and not (bpc == BATCH_PER_CHIP and layers == LAYERS)
+        ]
+    for bpc, layers in plan:
+        if stop_at is not None and time.monotonic() >= stop_at:
+            log("sweep stopped at its time budget; returning "
+                f"{len(points)} completed points")
+            # Sentinel: marks the list as incomplete so the evidence
+            # recorder won't let it displace a complete committed sweep.
+            points.append({"truncated": "time budget"})
+            return points
+        try:
+            r = _with_deadline(
+                lambda: bench_transformer(
+                    jax, batch_per_chip=bpc, layers=layers,
+                    trials=2, steps=10, warmup=5,
+                ),
+                point_deadline,
+                f"sweep bs={bpc} L={layers}",
+            )
+            points.append({
+                "batch_per_chip": bpc,
+                "layers": layers,
+                "tokens_per_sec_chip": r["median"],
+                "mfu": r["mfu"],
+                "spread": r["spread"],
+                "steady_state_mfu": r.get("paired_window", {}).get(
+                    "steady_state_mfu"
+                ),
+            })
+            log(
+                f"sweep bs/chip={bpc} layers={layers}: "
+                f"{r['median']:,.0f} tok/s/chip, mfu={r['mfu']}"
+            )
+        except Exception as e:
+            log(f"sweep point bs={bpc} layers={layers} failed: {e!r}")
+            points.append({
+                "batch_per_chip": bpc, "layers": layers, "error": repr(e),
+            })
+            if isinstance(e, TimeoutError):
+                # Single strike: the abandoned thread may STILL be
+                # executing on the chip once its RPC un-wedges — any
+                # further point would measure contention, not the
+                # framework (same reasoning as _transient_retry's
+                # fatal-TimeoutError rule).
+                log("sweep quarantined after a hung point")
+                points.append({"truncated": "hung point"})
+                return points
     return points
 
 

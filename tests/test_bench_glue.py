@@ -173,8 +173,9 @@ def test_record_merges_per_stage(tmp_path, monkeypatch):
 
 
 def test_record_skips_failed_stages(tmp_path, monkeypatch):
-    """A failed stage must not overwrite the record with an error dict (or
-    a partial sweep), and a run where nothing succeeded must leave the old
+    """A failed stage must not overwrite the record with an error dict; a
+    sweep banks its CLEAN rows only (error/truncated rows cost that point,
+    never the survivors); a run where nothing succeeded leaves the old
     record untouched."""
     path = tmp_path / "ev.json"
     monkeypatch.setattr(bench, "_EVIDENCE_PATH", str(path))
@@ -187,16 +188,16 @@ def test_record_skips_failed_stages(tmp_path, monkeypatch):
     ev = bench._load_tpu_evidence()
     assert "packed" not in ev
     assert "composed" not in ev  # budget skip is not a measurement
-    assert "sweep" not in ev  # partial sweep must not look complete
+    # The clean salvage row banks even though the sweep as a whole crashed.
+    assert ev["sweep"] == [{"batch_per_chip": 128, "layers": 1}]
     skip_sweep = dict(MT)
     skip_sweep["sweep"] = {"skipped": "total budget"}
     bench._record_tpu_evidence(skip_sweep)
-    assert "sweep" not in bench._load_tpu_evidence()
-    # A time-budget-truncated sweep (sentinel appended by the sweep loop,
-    # no sweep_error) must not displace a complete committed record either.
-    full = dict(MT)
-    full["sweep"] = [{"batch_per_chip": 128, "layers": 1, "mfu": 0.2}]
-    bench._record_tpu_evidence(full)
+    assert bench._load_tpu_evidence()["sweep"] == [
+        {"batch_per_chip": 128, "layers": 1}
+    ]  # a deliberate skip banks nothing and erases nothing
+    # A truncated sweep's clean rows merge per config (newest wins); the
+    # sentinel itself never lands in the record.
     trunc = dict(MT)
     trunc["sweep"] = [
         {"batch_per_chip": 128, "layers": 1, "mfu": 0.1},
@@ -204,7 +205,7 @@ def test_record_skips_failed_stages(tmp_path, monkeypatch):
     ]
     bench._record_tpu_evidence(trunc)
     ev = bench._load_tpu_evidence()
-    assert ev["sweep"] == [{"batch_per_chip": 128, "layers": 1, "mfu": 0.2}]
+    assert ev["sweep"] == [{"batch_per_chip": 128, "layers": 1, "mfu": 0.1}]
     before = path.read_text()
     bench._record_tpu_evidence({"error": "boom", "cnn": {"error": "x"}})
     assert path.read_text() == before  # nothing measured → keep old record
@@ -259,3 +260,40 @@ def test_stage_failure_does_not_void_others(stage_env, capsys):
     assert "error" in out["packed"]
     assert "sweep" in out  # non-timeout failure does not quarantine
     assert "after_timeout" not in out["cnn"]
+
+
+def test_record_merges_sweep_rows_per_config(tmp_path, monkeypatch):
+    """A BENCH_SWEEP_POINTS-restricted re-capture (e.g. just the L=4 rows
+    a hang stole) must merge into the recorded sweep per (batch, layers),
+    not replace it — the rows that landed in an earlier window survive."""
+    monkeypatch.setattr(bench, "_EVIDENCE_PATH", str(tmp_path / "ev.json"))
+    first = dict(MT)
+    first["sweep"] = [
+        {"batch_per_chip": 128, "layers": 1, "mfu": 0.18},
+        {"batch_per_chip": 32, "layers": 4, "mfu": 0.10},
+    ]
+    bench._record_tpu_evidence(first)
+    second = dict(MT)
+    second["sweep"] = [{"batch_per_chip": 32, "layers": 4, "mfu": 0.25}]
+    bench._record_tpu_evidence(second)
+    ev = bench._load_tpu_evidence()
+    rows = {(p["batch_per_chip"], p["layers"]): p["mfu"] for p in ev["sweep"]}
+    assert rows == {(128, 1): 0.18, (32, 4): 0.25}
+
+
+def test_sweep_points_env_restricts_plan(monkeypatch):
+    """BENCH_SWEEP_POINTS runs exactly the named (batch x layers) points —
+    scarce tunnel windows must not re-measure rows that already landed."""
+    monkeypatch.setenv("BENCH_SWEEP_POINTS", "32x4,128X4")
+    ran = []
+
+    def fake_bench_transformer(jax, batch_per_chip=None, layers=None, **kw):
+        ran.append((batch_per_chip, layers))
+        return {
+            "median": 1.0, "mfu": 0.1, "spread": 1.0, "paired_window": {},
+        }
+
+    monkeypatch.setattr(bench, "bench_transformer", fake_bench_transformer)
+    points = bench.bench_transformer_sweep(jax=None)
+    assert ran == [(32, 4), (128, 4)]
+    assert [(p["batch_per_chip"], p["layers"]) for p in points] == ran

@@ -47,6 +47,21 @@ def main() -> int:
     ap.add_argument("examples", nargs="*", default=None)
     ns = ap.parse_args()
 
+    if ns.platform == "default":
+        # State which backend "default" resolved to, in a subprocess so a
+        # wedged tunnel costs one timeout, not a parent hang. The capture
+        # session gates its TPU done-marker on this line: a silent CPU
+        # fallback must not freeze the sweep as TPU evidence.
+        try:
+            subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('sweep platform:',"
+                 " jax.devices()[0].platform, flush=True)"],
+                cwd=REPO, timeout=300,
+            )
+        except subprocess.TimeoutExpired:
+            print("sweep platform: unresolved (probe timeout)", flush=True)
+
     failures = 0
     for name in ns.examples or EXAMPLES:
         if ns.platform == "cpu":

@@ -1,96 +1,265 @@
 #!/bin/bash
-# One-stop TPU capture session. Probes the axon tunnel in a loop; on the
-# first successful probe runs, in order, on the live chip:
-#   1. full bench.py            -> BENCH_SELF_r05.json/.log
-#   2. short bench re-run       -> BENCH_SELF_r05_cachecheck.log
-#      (fresh process, same programs: its warmup time vs run 1's validates
-#      the persistent XLA compile cache against the axon backend)
-#   3. tools/longctx_bench.py   -> LONGCTX_r05.json/.log (seq 2048/4096/8192)
-#   4. tools/examples_sweep.py  -> EXAMPLES_TPU_r05.log (entry points on TPU)
-# Any step producing a CPU-fallback artifact sends the loop back to probing
-# (tunnel died between probe and launch); steps 2-4 are best-effort and
-# never block the loop's exit once step 1 has a TPU artifact.
+# One-stop TPU capture session, v2 — reprobing, priority-ordered.
+#
+# v1 ran its five steps strictly sequentially after ONE successful probe;
+# the 2026-07-31 18:45 window showed why that fails: the tunnel died 26
+# minutes in, and every remaining step would have burned its full timeout
+# (3h+) against a dead tunnel before the session declared itself done with
+# the highest-value artifact (long-context) never measured.
+#
+# v2 rules:
+#   - every step is gated on a fresh probe; a failed step sends the loop
+#     back to probing instead of on to the next step's timeout;
+#   - steps run in VALUE order (long-context numbers exist nowhere else,
+#     so they go first; the clean bench re-run fixes the evidence record;
+#     the rest are best-effort);
+#   - each step has a done-marker (/tmp/cap_done_*) and an attempt cap, so
+#     completed steps never re-run and a poisoned step cannot eat every
+#     window;
+#   - incremental-output tools (longctx, decode) APPEND across attempts so
+#     a half-finished window's completed configs are kept;
+#   - hard stop at STOP_AT (well before the judge's end-of-round bench):
+#     checked between steps AND enforced inside each step by capping its
+#     timeout at the time remaining, so a step launched late cannot
+#     overrun the stop by its full budget. At/after STOP_AT the session
+#     writes /tmp/capture_done and exits whatever remains.
+#
+# Steps and artifacts:
+#   longctx    tools/longctx_bench.py     -> LONGCTX_r05.json/.log
+#   cleanbench bench.py headline+CNN+L4   -> BENCH_SELF_r05b.json/.log
+#              (refreshes TPU_EVIDENCE.json on a clean, non-suspect run)
+#   cachecheck short fresh-process bench  -> BENCH_SELF_r05_cachecheck.log
+#   examples   tools/examples_sweep.py    -> EXAMPLES_TPU_r05.log
+#   decode     tools/decode_bench.py      -> DECODE_r05.json/.log
 cd /root/repo || exit 1
 note() { echo "$(date -Is) $*" >> /tmp/tpu_watch.out; }
-while true; do
+STOP_AT=$(date -u -d '2026-08-01 05:30:00' +%s)
+
+# Remaining seconds until STOP_AT, floored at 0.
+rem() {
+  local r=$(( STOP_AT - $(date +%s) ))
+  [ "$r" -lt 0 ] && r=0
+  echo "$r"
+}
+# min(wanted step budget, time left) — the in-step half of the hard stop.
+capped() {
+  local want=$1 r
+  r=$(rem)
+  [ "$r" -lt "$want" ] && echo "$r" || echo "$want"
+}
+
+probe() {
   # 240s: a LIVE tunnel's init+first-compile has measured ~90s from cold,
-  # and a dead one hangs forever — a 120s timeout risks misclassifying a
-  # sluggish-but-alive tunnel on exactly the probe that mattered.
-  if timeout 240 python - <<'EOF' >/tmp/tpu_probe.log 2>&1
+  # and a dead one hangs forever — a shorter timeout risks misclassifying
+  # a sluggish-but-alive tunnel on exactly the probe that mattered.
+  timeout 240 python - <<'EOF' >/tmp/tpu_probe.log 2>&1
 import os
 os.environ['JAX_PLATFORMS'] = 'axon'
 import jax, jax.numpy as jnp
 x = jnp.ones((128, 128))
 print(float((x @ x).sum()), jax.devices())
 EOF
-  then
-    date -Is > /tmp/tpu_alive
-    note "tunnel alive — step 1: full bench"
-    # Outer timeout: BENCH_PLATFORM=axon skips the subprocess probe, so a
-    # hang during backend INIT (before any workload deadline arms) would
-    # otherwise wedge forever.
-    # Budget sized to the observed alive-window scale (round 4's was ~47
-    # min): the bench self-paces to ~45 min so one window can also fit the
-    # long-context and decode steps; stage order already puts the headline
-    # first and the sweep last.
-    BENCH_ROUND=r05 BENCH_PLATFORM=axon BENCH_TOTAL_BUDGET=2700 \
-      timeout 3600 python bench.py \
-      > BENCH_SELF_r05.json 2> BENCH_SELF_r05.log
-    rc=$?
-    if ! python - BENCH_SELF_r05.json BENCH_SELF_r05.log <<'EOF'
+}
+
+done_f() { [ -f "/tmp/cap_done_$1" ]; }
+mark() { date -Is > "/tmp/cap_done_$1"; note "step $1: done"; }
+attempts() { cat "/tmp/cap_try_$1" 2>/dev/null || echo 0; }
+bump() { echo $(( $(attempts "$1") + 1 )) > "/tmp/cap_try_$1"; }
+
+all_done() {
+  done_f longctx && done_f cleanbench && done_f cachecheck \
+    && done_f examples && done_f decode
+}
+
+finish() { date -Is > /tmp/capture_done; note "capture session: $1"; exit 0; }
+
+run_longctx() {
+  bump longctx
+  # Appends: the tool writes one JSON line per (seq, impl) config as it
+  # completes, so a window that dies mid-sweep still banks its configs.
+  # The separator newline keeps a timeout-truncated previous line from
+  # swallowing this attempt's first record too.
+  [ -s LONGCTX_r05.json ] && printf '\n' >> LONGCTX_r05.json
+  JAX_PLATFORMS=axon timeout "$(capped 4500)" python tools/longctx_bench.py \
+    >> LONGCTX_r05.json 2>> LONGCTX_r05.log
+  rc=$?
+  note "longctx attempt $(attempts longctx) rc=$rc"
+  python - <<'EOF' && mark longctx
 import json, sys
-try:
-    r = json.load(open(sys.argv[1]))
-except Exception:
-    sys.exit(1)  # no parseable artifact (e.g. killed by the outer timeout)
-if "tpu" in str(r.get("device", "")).lower():
-    sys.exit(0)
-# The device field only lands when the headline stage succeeds; a run
-# whose headline errored but whose other stages measured on chip is still
-# a TPU run. The CPU-fallback markers in the log are the ground truth.
-try:
-    log_text = open(sys.argv[2]).read()
-except Exception:
-    sys.exit(1)
-fell_back = "falling back to CPU" in log_text or "non-TPU backend" in log_text
-sys.exit(1 if fell_back else 0)
+ok = []
+for l in open("LONGCTX_r05.json"):
+    try:
+        ok.append(json.loads(l))
+    except Exception:
+        pass  # a timeout-killed attempt can truncate its last line
+# Done = at least one measured flash config per seq length, none of them
+# a dead-backend refusal. (Dense may legitimately OOM/fail — that IS the
+# result — so only flash gates completion.)
+seqs = {r.get("seq") for r in ok
+        if r.get("impl") == "flash" and r.get("tokens_per_sec_chip")}
+sys.exit(0 if {2048, 4096, 8192} <= seqs else 1)
 EOF
-    then
-      note "bench rc=$rc but artifact not TPU — reprobing"
-      sleep 60
-      continue
-    fi
-    note "step 1 done rc=$rc (TPU artifact)"
-    note "step 2: cache-check re-run (headline only, short)"
-    BENCH_ROUND=r05 BENCH_PLATFORM=axon BENCH_TRIALS=2 BENCH_TPU_STEPS=20 \
-      BENCH_SKIP_SCANNED=1 BENCH_SKIP_PACKED=1 BENCH_SKIP_COMPOSED=1 \
-      BENCH_SKIP_SWEEP=1 BENCH_SKIP_TORCH=1 BENCH_CNN_TRIALS=1 \
-      timeout 1200 python bench.py \
-      > /tmp/bench_cachecheck.json 2> BENCH_SELF_r05_cachecheck.log
-    note "step 2 done rc=$? (compare 'warmup done' timestamps in the logs)"
-    note "step 3: long-context bench"
-    # Budget: 6 (seq, impl) configs x 600s per-config deadline + compile
-    # slack; the outer timeout is the backstop for a hang during backend
-    # init, not the scheduler for healthy configs.
-    JAX_PLATFORMS=axon timeout 4500 python tools/longctx_bench.py \
-      > LONGCTX_r05.json 2> LONGCTX_r05.log
-    note "step 3 done rc=$?"
-    note "step 4: examples sweep on TPU"
-    # 300s per example (compile ~20-40s + seconds of train) so one hung
-    # tunnel RPC can't eat the whole step's outer timeout.
-    timeout 3600 python tools/examples_sweep.py --platform default \
-      --timeout 420 > EXAMPLES_TPU_r05.log 2>&1
-    note "step 4 done rc=$?"
-    note "step 5: decode throughput bench"
-    JAX_PLATFORMS=axon timeout 2400 python tools/decode_bench.py \
-      > DECODE_r05.json 2> DECODE_r05.log
-    note "step 5 done rc=$?"
-    note "capture session complete"
-    # Tells the supervisor loop (tools/tpu_capture_supervisor.sh) not to
-    # relaunch: a completed capture must not re-run into the judge's own
-    # end-of-round bench window.
-    date -Is > /tmp/capture_done
-    exit 0
+  if ! done_f longctx && [ "$(attempts longctx)" -ge 3 ]; then
+    note "longctx: attempt cap reached — accepting partial artifact"
+    mark longctx
+  fi
+}
+
+run_cleanbench() {
+  bump cleanbench
+  # Headline (10x240-step windows) + CNN + the sweep points the r05a hang
+  # stole, and nothing that already landed cleanly (scanned/packed/
+  # composed ride from BENCH_SELF_r05.json). A non-suspect run refreshes
+  # TPU_EVIDENCE.json, fixing the record the r05a noise window spoiled.
+  local n
+  n=$(attempts cleanbench)
+  # Per-attempt artifacts: a later attempt killed mid-write must not
+  # destroy an earlier attempt's near-good capture; the gate promotes the
+  # BEST attempt to the canonical name every time.
+  BENCH_ROUND=r05 BENCH_PLATFORM=axon BENCH_TOTAL_BUDGET=2400 \
+    BENCH_SWEEP_POINTS=32x4,128x4,256x4 BENCH_SWEEP_POINT_DEADLINE=900 \
+    BENCH_SKIP_SCANNED=1 BENCH_SKIP_PACKED=1 BENCH_SKIP_COMPOSED=1 \
+    timeout "$(capped 3300)" python bench.py \
+    > "/tmp/r05b_try$n.json" 2> "BENCH_SELF_r05b_try$n.log"
+  rc=$?
+  note "cleanbench attempt $n rc=$rc"
+  python - <<'EOF' && mark cleanbench
+import glob, json, shutil, sys
+best, best_key = None, None
+for path in sorted(glob.glob("/tmp/r05b_try*.json")):
+    try:
+        r = json.load(open(path))
+    except ValueError:
+        continue
+    if "tpu" not in str(r.get("device", "")).lower() or not r.get("median"):
+        continue
+    rows = [p for p in (r.get("sweep") or []) if isinstance(p, dict)
+            and "error" not in p and "truncated" not in p]
+    # Rank: most clean sweep rows, then tightest headline spread.
+    key = (len(rows), -(r.get("spread") or 99))
+    if best_key is None or key > best_key:
+        best, best_key, best_path = r, key, path
+if best is None:
+    sys.exit(1)
+shutil.copy(best_path, "BENCH_SELF_r05b.json")
+log = best_path.replace("/tmp/r05b_try", "BENCH_SELF_r05b_try")
+log = log.replace(".json", ".log")
+try:
+    shutil.copy(log, "BENCH_SELF_r05b.log")
+except OSError:
+    pass
+# Gates: a trustworthy headline (the spread bar another noise-window
+# capture must retry under) AND the recaptured L=4 sweep rows — the two
+# things this re-run exists for.
+sys.exit(0 if (best.get("spread") or 99) <= 2.0 and best_key[0] >= 3 else 1)
+EOF
+  if ! done_f cleanbench && [ "$(attempts cleanbench)" -ge 3 ]; then
+    note "cleanbench: attempt cap reached — accepting best artifact"
+    mark cleanbench
+  fi
+}
+
+run_cachecheck() {
+  bump cachecheck
+  BENCH_ROUND=r05 BENCH_PLATFORM=axon BENCH_TRIALS=2 BENCH_TPU_STEPS=20 \
+    BENCH_SKIP_SCANNED=1 BENCH_SKIP_PACKED=1 BENCH_SKIP_COMPOSED=1 \
+    BENCH_SKIP_SWEEP=1 BENCH_SKIP_TORCH=1 BENCH_CNN_TRIALS=1 \
+    BENCH_CNN_STEPS=20 \
+    timeout "$(capped 1200)" python bench.py \
+    > /tmp/bench_cachecheck.json 2> BENCH_SELF_r05_cachecheck.log
+  rc=$?
+  note "cachecheck attempt $(attempts cachecheck) rc=$rc (compare setup+warmup vs the full run's)"
+  # TPU-gated: the whole point is axon-backend warmup time — a CPU
+  # fallback (bench.py falls back rather than fails) logs "warmup done"
+  # too but validates nothing; it must not freeze the step.
+  if grep -q "warmup done" BENCH_SELF_r05_cachecheck.log \
+      && python - <<'EOF'
+import json, sys
+r = json.load(open("/tmp/bench_cachecheck.json"))
+sys.exit(0 if "tpu" in str(r.get("device", "")).lower() else 1)
+EOF
+  then
+    mark cachecheck
+  fi
+  if ! done_f cachecheck && [ "$(attempts cachecheck)" -ge 3 ]; then
+    note "cachecheck: attempt cap reached"
+    mark cachecheck
+  fi
+}
+
+run_examples() {
+  bump examples
+  # 420s per example (compile ~20-40s + seconds of train) so one hung
+  # tunnel RPC can't eat the whole step's outer timeout. Each attempt
+  # gets its own log and is gated ALONE — grepping the cumulative log
+  # could pair one attempt's "platform: tpu" line with a later CPU-
+  # fallback attempt's passing summary.
+  : > /tmp/examples_attempt.log
+  timeout "$(capped 3600)" python tools/examples_sweep.py \
+    --platform default --timeout 420 >> /tmp/examples_attempt.log 2>&1
+  rc=$?
+  cat /tmp/examples_attempt.log >> EXAMPLES_TPU_r05.log
+  note "examples attempt $(attempts examples) rc=$rc"
+  # Done only on THIS attempt's full-sweep summary (N/N rc=0, N>=1) AND
+  # its backend line proving "default" resolved to the chip — neither a
+  # single passing example nor a silent CPU-fallback sweep may freeze the
+  # step as TPU evidence.
+  grep -E "examples sweep: ([1-9][0-9]*)/\1 rc=0" /tmp/examples_attempt.log \
+    > /dev/null \
+    && grep -q "sweep platform: tpu" /tmp/examples_attempt.log \
+    && mark examples
+  if ! done_f examples && [ "$(attempts examples)" -ge 2 ]; then
+    note "examples: attempt cap reached"
+    mark examples
+  fi
+}
+
+run_decode() {
+  bump decode
+  [ -s DECODE_r05.json ] && printf '\n' >> DECODE_r05.json
+  JAX_PLATFORMS=axon timeout "$(capped 2400)" python tools/decode_bench.py \
+    >> DECODE_r05.json 2>> DECODE_r05.log
+  rc=$?
+  note "decode attempt $(attempts decode) rc=$rc"
+  python - <<'EOF' && mark decode
+import json, sys
+got = set()
+for l in open("DECODE_r05.json"):
+    try:
+        d = json.loads(l)
+    except Exception:
+        continue
+    if d.get("new_tokens_per_sec_chip"):
+        got.add(d.get("decoder"))
+sys.exit(0 if {"greedy_cached", "beam4", "greedy_naive"} <= got else 1)
+EOF
+  if ! done_f decode && [ "$(attempts decode)" -ge 3 ]; then
+    note "decode: attempt cap reached — accepting partial artifact"
+    mark decode
+  fi
+}
+
+while true; do
+  all_done && finish "all steps complete"
+  [ "$(rem)" -le 60 ] && finish "stop deadline reached"
+  if probe; then
+    date -Is > /tmp/tpu_alive
+    for step in longctx cleanbench cachecheck examples decode; do
+      done_f "$step" && continue
+      [ "$(rem)" -le 60 ] && finish "stop deadline reached"
+      note "tunnel alive — step $step (attempt $(( $(attempts "$step") + 1 )))"
+      "run_$step"
+      # Everything done, or out of time? Settle that before spending up
+      # to 240s on a probe nobody will use.
+      all_done && finish "all steps complete"
+      [ "$(rem)" -le 60 ] && finish "stop deadline reached"
+      # Re-probe before spending another step's timeout: if the tunnel
+      # died during this step, go back to patient probing instead.
+      if ! probe; then
+        note "tunnel lost after step $step — back to probing"
+        break
+      fi
+    done
   else
     date -Is > /tmp/tpu_dead
     sleep 120
