@@ -934,7 +934,7 @@ def bench_composed(
     reference MT model — sequence packing (input density: ~11-12 pairs per
     200-token row instead of 1), scanned dispatch (``fit(steps_per_call=K)``
     semantics: K steps per host RPC), and a large batch (MXU tiling +
-    fixed-cost amortization; see docs/tpu_roofline.md). This is the config
+    fixed-cost amortization; see TPU_ROOFLINE.md). This is the config
     a real user of the framework would run the reference's Multi30k workload
     at (``pytorch_machine_translator.py:199-205`` contract); the headline
     stages keep the reference's own bs=32 per-step shape for comparability,
