@@ -131,8 +131,11 @@ def _render_rest(out) -> None:
     lc = _load("LONGCTX_r05.json")
     if isinstance(lc, list):
         out.append("\n### Long context (flash vs dense)\n")
-        out.append("| seq | impl | tok/s/chip | MFU | spread | note |")
-        out.append("|---|---|---|---|---|---|")
+        out.append(
+            "| seq | impl | tok/s/chip | MFU | spread "
+            "| peak HBM GB (cumulative) | note |"
+        )
+        out.append("|---|---|---|---|---|---|---|")
         for r in lc:
             if "summary" in r or "stopped" in r:
                 continue
@@ -140,7 +143,8 @@ def _render_rest(out) -> None:
             out.append(
                 f"| {r.get('seq')} | {r.get('impl')} | "
                 f"{fmt(r.get('tokens_per_sec_chip'))} | {r.get('mfu', '—')} "
-                f"| {r.get('spread', '—')} | {note} |"
+                f"| {r.get('spread', '—')} "
+                f"| {r.get('peak_hbm_gb_cumulative', '—')} | {note} |"
             )
         for r in lc:
             if "summary" in r:

@@ -29,9 +29,33 @@ def main() -> None:
         return
     from machine_learning_apache_spark_tpu.ops.attention import attention_impl
 
+    def _hbm_gb():
+        # HBM note per config: the flash kernel's O(S) claim vs the dense
+        # path's [B,H,S,S] score tensor is a MEMORY claim first — record
+        # it, not just the throughput. The allocator's peak counter is
+        # cumulative over the PROCESS (no reset API), so it is labeled as
+        # such: the first config's peak is exact; later configs' peaks
+        # are a running max and only meaningful when they RISE. Current
+        # bytes_in_use accompanies it. memory_stats is optional per
+        # backend; absence degrades to null, never fails the config.
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            out = {}
+            if stats.get("peak_bytes_in_use"):
+                out["peak_hbm_gb_cumulative"] = round(
+                    stats["peak_bytes_in_use"] / 2**30, 3
+                )
+            if stats.get("bytes_in_use"):
+                out["hbm_gb_in_use"] = round(
+                    stats["bytes_in_use"] / 2**30, 3
+                )
+            return out
+        except Exception:  # noqa: BLE001
+            return {}
+
     def run(seq, bpc, impl):
         with attention_impl(impl):
-            return bench._with_deadline(
+            r = bench._with_deadline(
                 lambda: bench.bench_transformer(
                     jax, batch_per_chip=bpc, trials=3, steps=5, warmup=5,
                     seq=seq,
@@ -39,6 +63,8 @@ def main() -> None:
                 600,
                 f"longctx seq={seq} {impl}",
             )
+        r.update(_hbm_gb())
+        return r
 
     results = []
     for seq, bpc in ((2048, 16), (4096, 8), (8192, 4)):
@@ -51,11 +77,18 @@ def main() -> None:
                     "spread": r["spread"],
                     "paired": r.get("paired_window", {}),
                 }
+                for k in ("peak_hbm_gb_cumulative", "hbm_gb_in_use"):
+                    if k in r:
+                        out[k] = r[k]
             except Exception as e:  # noqa: BLE001 — record and continue
                 out = {
                     "seq": seq, "batch_per_chip": bpc, "impl": impl,
                     "error": repr(e),
                 }
+                # Peak-at-failure is the most informative memory reading
+                # the tool can take: for a dense OOM it shows how full
+                # HBM was when the [B,H,S,S] materialization broke.
+                out.update(_hbm_gb())
                 # A dense OOM is an expected, *informative* failure (the
                 # [B,H,S,S] tensor outgrowing HBM) — label it so the
                 # artifact reads as evidence, not as a broken run.
