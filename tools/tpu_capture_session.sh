@@ -116,9 +116,13 @@ run_cleanbench() {
   # Per-attempt artifacts: a later attempt killed mid-write must not
   # destroy an earlier attempt's near-good capture; the gate promotes the
   # BEST attempt to the canonical name every time.
+  # BENCH_SKIP_TORCH: the torch-CPU baselines cost ~6 min of 1-core wall
+  # time while the chip idles inside a scarce alive window; the real
+  # vs-reference ratios are already on record in BENCH_SELF_r05.json.
   BENCH_ROUND=r05 BENCH_PLATFORM=axon BENCH_TOTAL_BUDGET=2400 \
     BENCH_SWEEP_POINTS=32x4,128x4,256x4 BENCH_SWEEP_POINT_DEADLINE=900 \
     BENCH_SKIP_SCANNED=1 BENCH_SKIP_PACKED=1 BENCH_SKIP_COMPOSED=1 \
+    BENCH_SKIP_TORCH=1 \
     timeout "$(capped 3300)" python bench.py \
     > "/tmp/r05b_try$n.json" 2> "BENCH_SELF_r05b_try$n.log"
   rc=$?
