@@ -306,6 +306,25 @@ class Translator:
         vocab = self.trg_pipe.vocab
         return [" ".join(vocab.lookup_tokens(row)) for row in rows]
 
+    def serve(self, *, start: bool = True, **engine_kwargs):
+        """Continuous-batching server over this translator — the
+        request-level layer ``__call__`` lacks: concurrent callers share
+        an admission queue, a shape-bucketed batcher, and a KV slot pool,
+        with every bucket's program precompiled at warmup.
+
+        >>> with t.serve(max_batch=8, boundaries=(16, 32)) as eng:
+        ...     futs = [eng.submit(s) for s in sentences]
+        ...     outs = [f.result(timeout=30) for f in futs]
+
+        ``start=False`` returns an unstarted engine (callers control
+        warmup/lifecycle); otherwise the engine arrives warmed up and
+        serving. Knobs pass through to ``serving.ServingEngine``.
+        """
+        from machine_learning_apache_spark_tpu.serving import ServingEngine
+
+        engine = ServingEngine(self, **engine_kwargs)
+        return engine.start() if start else engine
+
     # -- persistence ----------------------------------------------------------
     def save(self, directory: str) -> None:
         """One directory = one deployable model: params (orbax) + config +

@@ -1,0 +1,39 @@
+"""Request-level serving layer over the compiled decode core.
+
+The repo's inference story stops at ``inference.Translator`` — a one-shot,
+caller-owns-the-batch API. This package adds the layer the ROADMAP's
+"millions of users" north star needs: concurrent callers share a bounded
+admission queue (``queue``), a continuous batcher groups compatible
+requests into padded shape buckets so every batch hits an
+already-compiled XLA program (``batcher``), a fixed KV slot pool bounds
+in-flight decode state (``kv_slots``), and a background engine drives the
+cached decoders batch-by-batch (``engine``) while ``metrics`` keeps the
+latency/throughput ledger. Entry point: ``Translator.serve()``.
+"""
+
+from machine_learning_apache_spark_tpu.serving.batcher import Batch, Batcher
+from machine_learning_apache_spark_tpu.serving.engine import ServingEngine
+from machine_learning_apache_spark_tpu.serving.kv_slots import KVSlotPool
+from machine_learning_apache_spark_tpu.serving.metrics import (
+    Histogram,
+    ServingMetrics,
+)
+from machine_learning_apache_spark_tpu.serving.queue import (
+    Backpressure,
+    DeadlineExceeded,
+    RequestQueue,
+    ServeRequest,
+)
+
+__all__ = [
+    "Backpressure",
+    "Batch",
+    "Batcher",
+    "DeadlineExceeded",
+    "Histogram",
+    "KVSlotPool",
+    "RequestQueue",
+    "ServeRequest",
+    "ServingEngine",
+    "ServingMetrics",
+]

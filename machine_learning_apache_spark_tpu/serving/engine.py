@@ -1,0 +1,336 @@
+"""Serving engine — the decode loop behind ``Translator.serve()``.
+
+Wiring: caller threads tokenize and ``submit()`` into the admission
+queue; one background worker pulls shape-bucketed batches from the
+``Batcher``, takes KV slots for every member, pads the batch to the
+bucket's static ``[max_batch, boundary]`` shape, and runs the compiled
+cached decoder for that bucket. The eager path stays thin — tokenize,
+pad, dispatch — and everything hot is an already-compiled XLA program
+(the veScale split: request plumbing in Python, math in SPMD programs).
+
+Shape discipline is the whole game: one jitted callable per bucket
+boundary, batch always padded to ``max_batch`` (filler rows replicate
+row 0 — valid tokens, so no all-masked softmax — and are discarded), so
+``warmup()`` precompiles the complete program set and steady state runs
+with zero recompiles. ``recompiles_after_warmup`` watches the jit caches
+(via ``utils.compilation_cache``-style discipline, counted per callable)
+and is the demo/bench acceptance gate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from machine_learning_apache_spark_tpu.data.text import EOS_ID, SOS_ID
+from machine_learning_apache_spark_tpu.serving.batcher import Batch, Batcher
+from machine_learning_apache_spark_tpu.serving.kv_slots import KVSlotPool
+from machine_learning_apache_spark_tpu.serving.metrics import ServingMetrics
+from machine_learning_apache_spark_tpu.serving.queue import (
+    DeadlineExceeded,
+    RequestQueue,
+    ServeRequest,
+)
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+from machine_learning_apache_spark_tpu.utils.profiling import annotate
+
+log = get_logger(__name__)
+
+
+class EngineStopped(RuntimeError):
+    """The engine shut down before this request completed."""
+
+
+class ServingEngine:
+    """Continuous-batching server over a ``Translator``-shaped bundle
+    (``model``, ``params``, ``src_pipe``, ``trg_pipe``).
+
+    >>> with translator.serve(max_batch=8, boundaries=(16, 32)) as eng:
+    ...     futs = [eng.submit(s) for s in texts]
+    ...     outs = [f.result(timeout=30) for f in futs]
+
+    Tuning knobs (see docs/SERVING.md): ``boundaries`` pick the padded
+    shapes (and so the compile set), ``max_batch`` the throughput/memory
+    trade, ``max_wait_s`` the co-batching patience (tail latency bound),
+    ``max_queue_depth`` the backpressure point, ``num_slots`` the
+    in-flight ceiling.
+    """
+
+    def __init__(
+        self,
+        translator,
+        *,
+        boundaries: Sequence[int] = (16, 32, 64),
+        max_batch: int = 8,
+        max_wait_s: float = 0.02,
+        max_queue_depth: int = 64,
+        num_slots: int | None = None,
+        max_new_tokens: int | None = None,
+        default_deadline_s: float | None = None,
+        method: str = "greedy",
+        beam_size: int = 4,
+        length_penalty: float = 0.6,
+        clock=time.monotonic,
+    ):
+        cfg = translator.model.cfg
+        boundaries = tuple(sorted(boundaries))
+        if boundaries[-1] > cfg.max_len:
+            raise ValueError(
+                f"largest boundary {boundaries[-1]} exceeds the model's "
+                f"max_len {cfg.max_len}; positions past max_len have no "
+                "encoding"
+            )
+        if method not in ("greedy", "beam"):
+            raise ValueError(
+                f"method must be 'greedy' or 'beam', got {method!r}"
+            )
+        self.translator = translator
+        self.boundaries = boundaries
+        self.max_batch = max_batch
+        self.max_new_tokens = (
+            cfg.max_len - 1 if max_new_tokens is None else max_new_tokens
+        )
+        self.method = method
+        self.clock = clock
+        self.metrics = ServingMetrics(clock=clock)
+        self.queue = RequestQueue(
+            max_queue_depth, default_deadline_s=default_deadline_s,
+            clock=clock, on_expire=self.metrics.on_expire,
+        )
+        self.batcher = Batcher(
+            self.queue,
+            boundaries=boundaries,
+            max_batch=max_batch,
+            max_wait_s=max_wait_s,
+        )
+        # 2× max_batch by default: one batch decoding plus one forming.
+        self.pool = KVSlotPool(num_slots or 2 * max_batch)
+        self._decoders = {
+            b: self._make_decoder(beam_size, length_penalty)
+            for b in boundaries
+        }
+        self._compiles_at_warmup: int | None = None
+        self._stop = threading.Event()
+        self._worker: threading.Thread | None = None
+
+    def _make_decoder(self, beam_size: int, length_penalty: float):
+        """One jitted decode callable (its own jit cache → per-bucket
+        compile counting stays exact)."""
+        from machine_learning_apache_spark_tpu.models import (
+            beam_translate,
+            greedy_translate_cached,
+        )
+
+        model, mnt = self.translator.model, self.max_new_tokens
+        if self.method == "beam":
+            fn = lambda p, s: beam_translate(  # noqa: E731
+                model, p, s, beam_size=beam_size,
+                length_penalty=length_penalty, max_new_tokens=mnt,
+                sos_id=SOS_ID, eos_id=EOS_ID,
+            )
+        else:
+            fn = lambda p, s: greedy_translate_cached(  # noqa: E731
+                model, p, s, max_new_tokens=mnt, sos_id=SOS_ID, eos_id=EOS_ID,
+            )
+        return jax.jit(fn)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, *, warmup: bool = True) -> "ServingEngine":
+        if self._worker is not None:
+            raise RuntimeError("engine already started")
+        if warmup:
+            self.warmup()
+        self._stop.clear()
+        self._worker = threading.Thread(
+            target=self._serve_loop, name="serving-engine", daemon=True
+        )
+        self._worker.start()
+        return self
+
+    def stop(self, *, timeout: float = 30.0) -> None:
+        if self._worker is None:
+            return
+        self._stop.set()
+        with self.queue.cond:
+            self.queue.cond.notify_all()
+        self._worker.join(timeout)
+        self._worker = None
+        n = self.queue.fail_all(EngineStopped("serving engine stopped"))
+        if n:
+            log.info("engine stop failed %d queued requests", n)
+
+    def __enter__(self) -> "ServingEngine":
+        if self._worker is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- warmup / compile accounting ----------------------------------------
+    def warmup(self) -> int:
+        """Precompile every bucket's program on dummy full-size batches so
+        no live request ever pays a compile. Returns the program count."""
+        params = self.translator.params
+        row = [SOS_ID, EOS_ID]
+        for b in self.boundaries:
+            src = np.full((self.max_batch, b), self._pad_id, np.int32)
+            src[:, : len(row)] = row
+            with annotate(f"serve_warmup_b{b}"):
+                np.asarray(jax.block_until_ready(self._decoders[b](params, src)))
+        self._compiles_at_warmup = self.compile_count()
+        log.info(
+            "warmup compiled %d bucket programs (max_batch=%d, buckets=%s)",
+            len(self.boundaries), self.max_batch, list(self.boundaries),
+        )
+        return len(self.boundaries)
+
+    def compile_count(self) -> int | None:
+        """Total compiled programs across the bucket decoders, read from
+        each jitted callable's cache (None if the jax build doesn't
+        expose the probe)."""
+        from machine_learning_apache_spark_tpu.utils.compilation_cache import (
+            jit_cache_size,
+        )
+
+        sizes = [jit_cache_size(d) for d in self._decoders.values()]
+        if any(s is None for s in sizes):
+            return None
+        return sum(sizes)
+
+    @property
+    def recompiles_after_warmup(self) -> int | None:
+        """Programs compiled since ``warmup()`` — 0 in healthy steady
+        state (the demo/bench acceptance gate)."""
+        n = self.compile_count()
+        if n is None or self._compiles_at_warmup is None:
+            return None
+        return n - self._compiles_at_warmup
+
+    # -- request path --------------------------------------------------------
+    @property
+    def _pad_id(self) -> int:
+        return self.translator.model.cfg.pad_id
+
+    def submit(self, text: str, *, deadline_s: float | None = None) -> ServeRequest:
+        """Tokenize and admit one request; returns its ``ServeRequest``
+        (``.result(timeout)`` blocks for the translation). Raises
+        ``Backpressure`` at capacity and ``ValueError`` for inputs no
+        bucket can hold — both *before* the request costs decode work."""
+        if self._worker is None:
+            raise RuntimeError("engine not started (use start() or `with`) ")
+        ids = self.translator.src_pipe.ragged([text])[0]
+        if len(ids) > self.boundaries[-1]:
+            raise ValueError(
+                f"input tokenizes to {len(ids)} ids, beyond the largest "
+                f"bucket boundary {self.boundaries[-1]}; raise boundaries "
+                "or shorten the input"
+            )
+        try:
+            req = self.queue.submit(text, ids, deadline_s=deadline_s)
+        except Exception:
+            self.metrics.on_reject()
+            raise
+        self.metrics.on_submit()
+        return req
+
+    # -- the decode loop -----------------------------------------------------
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.batcher.next_batch(timeout=0.05)
+            if batch is None:
+                continue
+            try:
+                self._run_batch(batch)
+            except Exception as e:  # noqa: BLE001 — a batch must never kill the loop
+                log.info("serve batch failed: %r", e)
+                for r in batch.requests:
+                    self.pool.release_owner(r.id)
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                self.metrics.on_failure(len(batch.requests))
+
+    def _take_slots(self, batch: Batch) -> list[ServeRequest]:
+        """All-or-nothing slot acquisition for the batch's live members,
+        shedding any member whose deadline passes while waiting."""
+        members = list(batch.requests)
+        while members and not self._stop.is_set():
+            now = self.clock()
+            live = [r for r in members if not r.expired(now)]
+            for r in members:
+                if r not in live:
+                    self.metrics.on_expire()
+                    r.future.set_exception(
+                        DeadlineExceeded(
+                            f"request {r.id} expired awaiting a KV slot"
+                        )
+                    )
+            members = live
+            if not members:
+                break
+            if self.pool.acquire_many([r.id for r in members], timeout=0.05):
+                return members
+        for r in members:  # engine stopping
+            if not r.future.done():
+                r.future.set_exception(EngineStopped("engine stopping"))
+        return []
+
+    def _run_batch(self, batch: Batch) -> None:
+        members = self._take_slots(batch)
+        if not members:
+            return
+        batch_start = self.clock()
+        src = np.full((self.max_batch, batch.boundary), self._pad_id, np.int32)
+        for i, r in enumerate(members):
+            row = r.ids[: batch.boundary]
+            src[i, : len(row)] = row
+        # Filler rows replicate row 0: real tokens keep every attention row
+        # well-formed, and rows past len(members) are simply discarded.
+        for i in range(len(members), self.max_batch):
+            src[i] = src[0]
+        with annotate(f"serve_decode_b{batch.boundary}"):
+            out = np.asarray(
+                jax.block_until_ready(
+                    self._decoders[batch.boundary](self.translator.params, src)
+                )
+            )
+        decode_done = self.clock()
+
+        from machine_learning_apache_spark_tpu.train.metrics import (
+            strip_special_ids,
+        )
+
+        rows = strip_special_ids(
+            out[: len(members)],
+            pad_id=self._pad_id, sos_id=SOS_ID, eos_id=EOS_ID,
+        )
+        vocab = self.translator.trg_pipe.vocab
+        new_tokens = 0
+        for r, row in zip(members, rows):
+            r.decode_done_time = decode_done
+            new_tokens += len(row) + 1  # emitted ids + the eos/stop token
+            text = " ".join(vocab.lookup_tokens(row))
+            # Slot frees at EOS — the row is done generating either way
+            # (eos emitted, or the max_new_tokens budget is exhausted).
+            self.pool.release_owner(r.id)
+            r.future.set_result(text)
+            done = self.clock()
+            self.metrics.on_complete(
+                queue_wait=batch_start - r.submit_time,
+                ttft=decode_done - r.submit_time,
+                total=done - r.submit_time,
+            )
+        decode_s = decode_done - batch_start
+        self.queue.note_serviced(len(members), decode_s)
+        self.metrics.on_batch(
+            n_requests=len(members),
+            max_batch=self.max_batch,
+            decode_s=decode_s,
+            new_tokens=new_tokens,
+            queue_depth=self.queue.depth,
+            slot_occupancy=self.pool.occupancy,
+        )

@@ -1,0 +1,195 @@
+"""Serving metrics — the ledger a load balancer and an SRE both read.
+
+Four kinds of signal, matching what the serving path actually controls:
+
+- **admission counters** — submitted / completed / rejected / expired:
+  the conservation law (submitted = completed + rejected + expired +
+  in-flight) that makes lost requests visible;
+- **latency histograms** — queue wait, TTFT (submit → decode done; batch
+  decode emits all tokens at once, so first token and last coincide),
+  total latency (submit → result set): the p50/p99 pair every latency
+  SLO is written against;
+- **utilization gauges** — queue depth, batch occupancy (filled rows /
+  max_batch — padding waste), KV slot occupancy, sampled once per batch;
+- **throughput** — generated tokens/sec over the serving window, the
+  number the decode bench reports for one batch, measured here under
+  concurrent load.
+
+Histograms store raw samples (serving windows are minutes, not months —
+a few thousand floats beat bucket-boundary error), and ``summary()``
+returns one plain dict so `tools/serve_bench.py` can emit it verbatim
+as a BENCH artifact. ``log_summary`` goes through ``utils.logging`` like
+every other metric line in the repo.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def percentile(samples: list[float], p: float) -> float | None:
+    """Classic nearest-rank percentile (p in [0, 100]): the smallest
+    sample with at least p% of the distribution at or below it. None on
+    no samples."""
+    if not samples:
+        return None
+    if not 0 <= p <= 100:
+        raise ValueError(f"percentile must be in [0, 100], got {p}")
+    ordered = sorted(samples)
+    rank = max(0, math.ceil(p / 100 * len(ordered)) - 1)
+    return ordered[rank]
+
+
+class Histogram:
+    """Thread-safe raw-sample histogram with percentile summaries."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._samples: list[float] = []
+
+    def record(self, value: float) -> None:
+        with self._lock:
+            self._samples.append(float(value))
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def percentile(self, p: float) -> float | None:
+        with self._lock:
+            return percentile(self._samples, p)
+
+    def summary(self) -> dict:
+        with self._lock:
+            s = list(self._samples)
+        if not s:
+            return {"count": 0}
+        return {
+            "count": len(s),
+            "mean": sum(s) / len(s),
+            "p50": percentile(s, 50),
+            "p90": percentile(s, 90),
+            "p99": percentile(s, 99),
+            "max": max(s),
+        }
+
+
+class ServingMetrics:
+    """One instance per engine; every field is safe to bump from the
+    submit path (caller threads) and the worker thread concurrently."""
+
+    def __init__(self, *, clock=time.monotonic):
+        self.clock = clock
+        self._lock = threading.Lock()
+        self.started_at = clock()
+        # admission counters
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.expired = 0
+        self.failed = 0
+        # throughput
+        self.batches = 0
+        self.tokens_out = 0
+        # latency histograms (seconds)
+        self.queue_wait = Histogram("queue_wait_s")
+        self.ttft = Histogram("ttft_s")
+        self.total_latency = Histogram("total_latency_s")
+        self.batch_latency = Histogram("batch_latency_s")
+        # utilization gauges, sampled per batch
+        self.batch_occupancy = Histogram("batch_occupancy")
+        self.slot_occupancy = Histogram("slot_occupancy")
+        self.queue_depth = Histogram("queue_depth")
+
+    # -- event hooks ---------------------------------------------------------
+    def on_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def on_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def on_expire(self, n: int = 1) -> None:
+        with self._lock:
+            self.expired += n
+
+    def on_failure(self, n: int = 1) -> None:
+        with self._lock:
+            self.failed += n
+
+    def on_batch(
+        self,
+        *,
+        n_requests: int,
+        max_batch: int,
+        decode_s: float,
+        new_tokens: int,
+        queue_depth: int,
+        slot_occupancy: float,
+    ) -> None:
+        with self._lock:
+            self.batches += 1
+            self.tokens_out += new_tokens
+        self.batch_latency.record(decode_s)
+        self.batch_occupancy.record(n_requests / max_batch)
+        self.queue_depth.record(queue_depth)
+        self.slot_occupancy.record(slot_occupancy)
+
+    def on_complete(self, *, queue_wait: float, ttft: float, total: float) -> None:
+        with self._lock:
+            self.completed += 1
+        self.queue_wait.record(queue_wait)
+        self.ttft.record(ttft)
+        self.total_latency.record(total)
+
+    # -- reporting -----------------------------------------------------------
+    @property
+    def tokens_per_sec(self) -> float:
+        elapsed = self.clock() - self.started_at
+        return self.tokens_out / elapsed if elapsed > 0 else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "expired": self.expired,
+            "failed": self.failed,
+            "batches": self.batches,
+            "tokens_out": self.tokens_out,
+            "tokens_per_sec": round(self.tokens_per_sec, 1),
+            "queue_wait_s": self.queue_wait.summary(),
+            "ttft_s": self.ttft.summary(),
+            "total_latency_s": self.total_latency.summary(),
+            "batch_latency_s": self.batch_latency.summary(),
+            "batch_occupancy": self.batch_occupancy.summary(),
+            "slot_occupancy": self.slot_occupancy.summary(),
+            "queue_depth": self.queue_depth.summary(),
+        }
+
+    def log_summary(self) -> dict:
+        s = self.summary()
+        log.info(
+            "serving: %d completed / %d submitted (%d rejected, %d expired,"
+            " %d failed) | %d batches, %d tokens @ %.1f tok/s | total p50 %s"
+            " p99 %s | batch occupancy p50 %s",
+            s["completed"], s["submitted"], s["rejected"], s["expired"],
+            s["failed"], s["batches"], s["tokens_out"], s["tokens_per_sec"],
+            _fmt(s["total_latency_s"].get("p50")),
+            _fmt(s["total_latency_s"].get("p99")),
+            _fmt(s["batch_occupancy"].get("p50")),
+        )
+        return s
+
+
+def _fmt(v: float | None) -> str:
+    return "n/a" if v is None else f"{v:.4f}"

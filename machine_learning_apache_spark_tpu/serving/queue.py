@@ -1,0 +1,198 @@
+"""Bounded admission queue — the front door of the serving engine.
+
+Every serving system needs a place where load exceeding capacity becomes
+an explicit, bounded decision instead of unbounded memory growth and
+silent tail-latency collapse. ``RequestQueue`` is that place: admission
+is refused with a ``Backpressure`` carrying a ``retry_after`` hint once
+depth hits the bound (the client-visible contract of an HTTP 429), and
+requests that outlive their deadline while still queued are failed with
+``DeadlineExceeded`` rather than decoded into a response nobody is
+waiting for — dead work is the first thing an overloaded server must
+shed.
+
+The queue is thread-safe and condition-backed: producers are caller
+threads (``ServingEngine.submit``), the single consumer is the batcher,
+which waits on the queue's condition for work. ``note_serviced`` feeds an
+EWMA of observed service time back from the engine so ``retry_after``
+tracks the server's actual drain rate instead of a constant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Sequence
+
+_REQUEST_IDS = itertools.count()
+
+
+class Backpressure(RuntimeError):
+    """Admission refused: queue at capacity. ``retry_after`` (seconds) is
+    the server's estimate of when capacity frees — the 429 Retry-After."""
+
+    def __init__(self, depth: int, retry_after: float):
+        super().__init__(
+            f"queue at capacity (depth={depth}); retry after "
+            f"~{retry_after:.3f}s"
+        )
+        self.depth = depth
+        self.retry_after = retry_after
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before a result was produced."""
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One in-flight translation request.
+
+    ``ids`` is the ragged (unpadded) token-id row — the bucketing key and
+    the payload the batcher pads. ``deadline`` is an absolute monotonic
+    time or None. The ``future`` resolves to the detokenized string (or
+    an exception); timestamps feed the metrics ledger.
+    """
+
+    text: str
+    ids: list[int]
+    submit_time: float
+    deadline: float | None = None
+    id: int = dataclasses.field(default_factory=lambda: next(_REQUEST_IDS))
+    future: Future = dataclasses.field(default_factory=Future)
+    # Stamped by the engine: when this request's batch finished decoding
+    # (its first-token-available time — batch decode emits all tokens at
+    # once, so TTFT and decode-done coincide here).
+    decode_done_time: float | None = None
+    slot: int | None = None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+    def result(self, timeout: float | None = None) -> str:
+        """Block for the translation (or re-raise its failure)."""
+        return self.future.result(timeout)
+
+
+class RequestQueue:
+    """FIFO of pending ``ServeRequest``s with bounded depth and deadline
+    hygiene. All mutation happens under one condition variable, shared
+    with the batcher (``cond``) so arrival wakes a waiting consumer."""
+
+    def __init__(
+        self,
+        max_depth: int,
+        *,
+        default_deadline_s: float | None = None,
+        clock=time.monotonic,
+        on_expire=None,
+    ):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self.default_deadline_s = default_deadline_s
+        self.clock = clock
+        # Observer for in-queue deadline deaths (the engine wires the
+        # metrics ledger here so queue-level expiry is not invisible).
+        self.on_expire = on_expire
+        self.cond = threading.Condition()
+        self._pending: list[ServeRequest] = []
+        # EWMA of per-request service time (seconds), fed by the engine;
+        # seeds the retry_after estimate before any batch has completed.
+        self._service_time_ewma = 0.05
+        self.rejected = 0
+        self.expired = 0
+
+    # -- producer side -------------------------------------------------------
+    def submit(
+        self,
+        text: str,
+        ids: Sequence[int],
+        *,
+        deadline_s: float | None = None,
+    ) -> ServeRequest:
+        """Admit a request or raise ``Backpressure``. Expired entries are
+        purged first so a burst of dead requests can't hold the door shut
+        against live ones."""
+        now = self.clock()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        with self.cond:
+            self._expire_locked(now)
+            if len(self._pending) >= self.max_depth:
+                self.rejected += 1
+                raise Backpressure(
+                    len(self._pending),
+                    self._service_time_ewma * (len(self._pending) + 1),
+                )
+            req = ServeRequest(
+                text=text,
+                ids=list(ids),
+                submit_time=now,
+                deadline=None if deadline_s is None else now + deadline_s,
+            )
+            self._pending.append(req)
+            self.cond.notify_all()
+            return req
+
+    # -- consumer side (call with ``cond`` held) -----------------------------
+    def pending_locked(self) -> list[ServeRequest]:
+        """Live pending requests, FIFO. Caller holds ``cond``."""
+        return list(self._pending)
+
+    def take_locked(self, requests: Sequence[ServeRequest]) -> None:
+        """Remove ``requests`` (a batcher's pick) from pending. Caller
+        holds ``cond``."""
+        chosen = {r.id for r in requests}
+        self._pending = [r for r in self._pending if r.id not in chosen]
+
+    def _expire_locked(self, now: float) -> list[ServeRequest]:
+        """Fail-and-drop every pending request whose deadline passed."""
+        dead = [r for r in self._pending if r.expired(now)]
+        if dead:
+            self._pending = [r for r in self._pending if not r.expired(now)]
+            self.expired += len(dead)
+            for r in dead:
+                r.future.set_exception(
+                    DeadlineExceeded(
+                        f"request {r.id} expired after "
+                        f"{now - r.submit_time:.3f}s in queue"
+                    )
+                )
+            if self.on_expire is not None:
+                self.on_expire(len(dead))
+        return dead
+
+    def expire_overdue(self) -> int:
+        """Public deadline sweep (the engine runs one per loop iteration);
+        returns the number of requests dropped."""
+        with self.cond:
+            return len(self._expire_locked(self.clock()))
+
+    # -- feedback / introspection -------------------------------------------
+    def note_serviced(self, n_requests: int, elapsed: float) -> None:
+        """Engine feedback after each batch: fold observed per-request
+        service time into the EWMA behind ``retry_after``."""
+        if n_requests <= 0 or elapsed <= 0:
+            return
+        per_req = elapsed / n_requests
+        with self.cond:
+            self._service_time_ewma = (
+                0.7 * self._service_time_ewma + 0.3 * per_req
+            )
+
+    @property
+    def depth(self) -> int:
+        with self.cond:
+            return len(self._pending)
+
+    def fail_all(self, exc: Exception) -> int:
+        """Drain every pending request with ``exc`` (engine shutdown)."""
+        with self.cond:
+            dead, self._pending = self._pending, []
+            for r in dead:
+                r.future.set_exception(exc)
+            self.cond.notify_all()
+            return len(dead)

@@ -69,6 +69,19 @@ def _reset_cache_singleton() -> None:
         pass
 
 
+def jit_cache_size(fn) -> int | None:
+    """Number of compiled programs held by one ``jax.jit`` callable —
+    the in-process compile counter behind the serving engine's
+    zero-recompiles-after-warmup invariant (each new (shape, dtype)
+    signature adds one). Reads jit's private cache-size probe; returns
+    None on jax builds that don't expose it (the counter is diagnostics,
+    never a dependency)."""
+    try:
+        return int(fn._cache_size())
+    except AttributeError:
+        return None
+
+
 def disable_compilation_cache() -> None:
     """Undo ``enable_compilation_cache`` (all three config keys — the cache
     settings are process-global JAX config, so a session that doesn't want

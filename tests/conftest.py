@@ -8,8 +8,23 @@ path run on one CPU host (SURVEY.md §4).
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Request 8 virtual CPU devices BEFORE jax can initialize a backend. On
+# jax >= 0.4.34 the config option below is authoritative; on older builds
+# (and on builds where the option is absent, like the installed 0.4.37)
+# the XLA flag is the only lever, and it must be in the environment before
+# the CPU client is created. Appending (not overwriting) preserves any
+# flags the hosting image set.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+) and "jax" not in sys.modules:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 # The hosting image may pre-import jax from sitecustomize (axon PJRT plugin),
 # in which case env vars are too late — use the config API, which works any
@@ -17,7 +32,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax 0.4.37 predates jax_num_cpu_devices; XLA_FLAGS above covers it
+    # (unless jax was pre-imported, in which case the device count is
+    # whatever the importer chose and mesh-shape-sensitive tests skip).
+    pass
 
 import sys
 

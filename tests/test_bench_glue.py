@@ -47,6 +47,12 @@ COMPOSED = {
 
 @pytest.fixture
 def stage_env(monkeypatch):
+    # Keep main() from enabling the persistent XLA compilation cache:
+    # every workload here is stubbed so the cache does nothing for these
+    # tests, but the config it flips is process-global and serializing
+    # later CPU compiles through it segfaults jaxlib 0.4.37 (observed on
+    # test_checkpoint's TP/EP program when run after this file).
+    monkeypatch.setenv("BENCH_COMPILE_CACHE", "0")
     monkeypatch.setenv("BENCH_FORCE_TPU_STAGES", "1")
     monkeypatch.setenv("BENCH_PLATFORM", "cpu")
     monkeypatch.setattr(bench, "bench_torch_transformer", lambda: 1200.0)

@@ -1,0 +1,387 @@
+"""serving/: admission queue, continuous batcher, KV slot pool, metrics,
+and the end-to-end engine — the request-level layer over the compiled
+decode core (docs/SERVING.md).
+
+Unit tests drive queue/batcher/slots with a fake clock (no sleeps where
+avoidable); the e2e class serves real concurrent requests through a tiny
+untrained Transformer on CPU and pins the two serving invariants: results
+identical to the one-shot ``Translator`` path, and zero recompiles after
+warmup.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu.serving import (
+    Backpressure,
+    Batcher,
+    DeadlineExceeded,
+    Histogram,
+    KVSlotPool,
+    RequestQueue,
+    ServingEngine,
+)
+from machine_learning_apache_spark_tpu.serving.metrics import percentile
+
+pytestmark = pytest.mark.serving
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestRequestQueue:
+    def test_backpressure_at_capacity_with_retry_after(self):
+        q = RequestQueue(max_depth=2)
+        q.submit("a", [1, 2])
+        q.submit("b", [3])
+        with pytest.raises(Backpressure) as ei:
+            q.submit("c", [4])
+        assert ei.value.retry_after > 0
+        assert ei.value.depth == 2
+        assert q.rejected == 1
+        # service-time feedback moves the hint
+        before = ei.value.retry_after
+        q.note_serviced(1, 10.0)
+        with pytest.raises(Backpressure) as ei2:
+            q.submit("c", [4])
+        assert ei2.value.retry_after > before
+
+    def test_expired_requests_fail_and_free_capacity(self):
+        clock = FakeClock()
+        q = RequestQueue(max_depth=1, clock=clock)
+        r = q.submit("a", [1], deadline_s=5.0)
+        clock.advance(6.0)
+        # the expired head must not hold the door shut
+        r2 = q.submit("b", [2], deadline_s=5.0)
+        with pytest.raises(DeadlineExceeded):
+            r.result(timeout=0)
+        assert q.expired == 1 and q.depth == 1
+        assert not r2.future.done()
+
+    def test_default_deadline_applies(self):
+        clock = FakeClock()
+        q = RequestQueue(max_depth=4, default_deadline_s=1.0, clock=clock)
+        r = q.submit("a", [1])
+        clock.advance(2.0)
+        assert q.expire_overdue() == 1
+        with pytest.raises(DeadlineExceeded):
+            r.result(timeout=0)
+
+    def test_fail_all_drains(self):
+        q = RequestQueue(max_depth=4)
+        rs = [q.submit(str(i), [i]) for i in range(3)]
+        assert q.fail_all(RuntimeError("down")) == 3
+        for r in rs:
+            with pytest.raises(RuntimeError, match="down"):
+                r.result(timeout=0)
+        assert q.depth == 0
+
+
+class TestBatcher:
+    def _mk(self, clock, **kw):
+        q = RequestQueue(max_depth=64, clock=clock)
+        kw.setdefault("boundaries", (4, 8))
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("max_wait_s", 1.0)
+        return q, Batcher(q, **kw)
+
+    def test_full_bucket_ships_immediately(self):
+        clock = FakeClock()
+        q, b = self._mk(clock)
+        q.submit("a", [1, 2])        # bucket 0 (len 2 ≤ 4)
+        q.submit("b", [1, 2, 3, 4, 5])  # bucket 1
+        q.submit("c", [3])           # bucket 0 → full
+        batch = b.next_batch(timeout=0)
+        assert batch is not None and batch.boundary == 4
+        assert [r.text for r in batch.requests] == ["a", "c"]
+        assert q.depth == 1  # the bucket-1 request stays queued
+
+    def test_partial_batch_waits_for_max_wait(self):
+        clock = FakeClock()
+        q, b = self._mk(clock)
+        q.submit("a", [1, 2])
+        assert b.next_batch(timeout=0) is None  # not full, not overdue
+        clock.advance(1.5)  # past max_wait_s
+        batch = b.next_batch(timeout=0)
+        assert batch is not None and len(batch) == 1
+        assert batch.requests[0].text == "a"
+
+    def test_overdue_prefers_fullest_bucket(self):
+        clock = FakeClock()
+        q, b = self._mk(clock, max_batch=3)
+        q.submit("a", [1, 2, 3, 4, 5])  # bucket 1, head of line
+        q.submit("b", [1])              # bucket 0
+        q.submit("c", [2])              # bucket 0
+        clock.advance(2.0)              # everyone overdue
+        batch = b.next_batch(timeout=0)
+        assert batch.boundary == 4 and len(batch) == 2  # fullest bucket wins
+        assert b.next_batch(timeout=0).boundary == 8  # then the head's own
+
+    def test_real_clock_max_wait_bounds_latency(self):
+        """Wall-clock: a lone request ships within ~max_wait, not never."""
+        q = RequestQueue(max_depth=8)
+        b = Batcher(q, boundaries=(4,), max_batch=8, max_wait_s=0.05)
+        t0 = time.monotonic()
+        q.submit("a", [1, 2])
+        batch = b.next_batch(timeout=2.0)
+        waited = time.monotonic() - t0
+        assert batch is not None and len(batch) == 1
+        assert waited < 1.0, f"max-wait did not bound formation ({waited:.3f}s)"
+
+    def test_expired_request_never_enters_a_batch(self):
+        clock = FakeClock()
+        q, b = self._mk(clock)
+        r = q.submit("a", [1], deadline_s=0.5)
+        clock.advance(2.0)
+        assert b.next_batch(timeout=0) is None
+        with pytest.raises(DeadlineExceeded):
+            r.result(timeout=0)
+
+
+class TestKVSlotPool:
+    def test_acquire_release_occupancy(self):
+        pool = KVSlotPool(4)
+        s0 = pool.try_acquire(owner_id=10)
+        s1 = pool.try_acquire(owner_id=11)
+        assert {s0, s1} == {0, 1} and pool.in_use == 2
+        assert pool.occupancy == 0.5 and pool.high_water == 2
+        pool.release(s0)
+        assert pool.in_use == 1 and pool.holder(s1) == 11
+        assert pool.release_owner(11) == 1
+        assert pool.free == 4 and pool.total_released == 2
+
+    def test_exhaustion_and_blocking_acquire(self):
+        pool = KVSlotPool(2)
+        pool.acquire_many([1, 2], timeout=0)
+        assert pool.try_acquire(3) is None
+        assert pool.acquire_many([3], timeout=0.01) is None
+        # a release from another thread unblocks the waiter
+        def free_later():
+            time.sleep(0.05)
+            pool.release_owner(1)
+
+        t = threading.Thread(target=free_later)
+        t.start()
+        got = pool.acquire_many([3], timeout=2.0)
+        t.join()
+        assert got is not None and pool.holder(got[0]) == 3
+
+    def test_all_or_nothing_and_impossible_batch(self):
+        pool = KVSlotPool(2)
+        with pytest.raises(ValueError, match="never fit"):
+            pool.acquire_many([1, 2, 3])
+        pool.try_acquire(9)
+        # 2 wanted, 1 free → nothing granted
+        assert pool.acquire_many([1, 2], timeout=0.01) is None
+        assert pool.in_use == 1
+
+    def test_release_unheld_slot_raises(self):
+        pool = KVSlotPool(1)
+        with pytest.raises(ValueError, match="not held"):
+            pool.release(0)
+        assert pool.release_owner(42) == 0  # idempotent by-owner free
+
+
+class TestMetrics:
+    def test_percentile_nearest_rank(self):
+        assert percentile([], 50) is None
+        assert percentile([3.0], 99) == 3.0
+        xs = [float(i) for i in range(1, 101)]
+        assert percentile(xs, 50) == 50.0
+        assert percentile(xs, 99) == 99.0
+        assert percentile(xs, 0) == 1.0 and percentile(xs, 100) == 100.0
+        with pytest.raises(ValueError):
+            percentile(xs, 101)
+
+    def test_histogram_summary(self):
+        h = Histogram("x")
+        assert h.summary() == {"count": 0}
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.record(v)
+        s = h.summary()
+        assert s["count"] == 4 and s["mean"] == 2.5 and s["max"] == 4.0
+
+    def test_serving_metrics_ledger(self):
+        from machine_learning_apache_spark_tpu.serving import ServingMetrics
+
+        clock = FakeClock()
+        m = ServingMetrics(clock=clock)
+        for _ in range(3):
+            m.on_submit()
+        m.on_reject()
+        m.on_expire()
+        clock.advance(2.0)
+        m.on_batch(n_requests=2, max_batch=4, decode_s=0.5, new_tokens=20,
+                   queue_depth=1, slot_occupancy=0.25)
+        m.on_complete(queue_wait=0.1, ttft=0.6, total=0.7)
+        s = m.summary()
+        assert s["submitted"] == 3 and s["rejected"] == 1 and s["expired"] == 1
+        assert s["tokens_out"] == 20 and s["tokens_per_sec"] == 10.0
+        assert s["batch_occupancy"]["p50"] == 0.5
+        assert m.log_summary()["completed"] == 1
+
+
+def test_jit_cache_size_counts_programs():
+    """The compile counter behind ``recompiles_after_warmup``: one entry
+    per traced signature, None (not a crash) if the probe ever vanishes."""
+    import jax
+    import jax.numpy as jnp
+
+    from machine_learning_apache_spark_tpu.utils.compilation_cache import (
+        jit_cache_size,
+    )
+
+    f = jax.jit(lambda x: x + 1)
+    n0 = jit_cache_size(f)
+    if n0 is None:
+        pytest.skip("this jax build exposes no jit cache probe")
+    f(jnp.zeros((2,)))
+    f(jnp.zeros((2,)))  # same shape: no new program
+    assert jit_cache_size(f) == n0 + 1
+    f(jnp.zeros((3,)))
+    assert jit_cache_size(f) == n0 + 2
+    assert jit_cache_size(object()) is None
+
+
+@pytest.fixture(scope="module")
+def tiny_translator():
+    """Untrained tiny MT bundle — serving semantics don't need a trained
+    model, and init is ~instant where training is not."""
+    import jax
+
+    from machine_learning_apache_spark_tpu.data.datasets import (
+        synthetic_translation_pairs,
+    )
+    from machine_learning_apache_spark_tpu.data.text import TextPipeline
+    from machine_learning_apache_spark_tpu.inference import Translator
+    from machine_learning_apache_spark_tpu.models import (
+        Transformer,
+        TransformerConfig,
+    )
+
+    pairs = synthetic_translation_pairs(64, min_len=3, max_len=8, seed=0)
+    src_pipe = TextPipeline.fit([s for s, _ in pairs], max_seq_len=14)
+    trg_pipe = TextPipeline.fit([t for _, t in pairs], max_seq_len=14)
+    cfg = TransformerConfig(
+        src_vocab_size=len(src_pipe.vocab.itos),
+        trg_vocab_size=len(trg_pipe.vocab.itos),
+        d_model=32, ffn_hidden=64, num_heads=2, num_layers=1,
+        max_len=16, dropout=0.0,
+    )
+    model = Transformer(cfg)
+    dummy = np.ones((2, 8), np.int32)
+    params = model.init(jax.random.key(0), dummy, dummy)["params"]
+    return Translator(model, params, src_pipe, trg_pipe), [
+        s for s, _ in pairs
+    ]
+
+
+class TestEngineE2E:
+    def test_concurrent_round_trip_matches_oneshot(self, tiny_translator):
+        """32 concurrent clients through the batcher produce exactly the
+        one-shot ``Translator.__call__`` outputs (bucket padding must be
+        semantics-free), with zero recompiles after warmup."""
+        t, texts = tiny_translator
+        texts = texts[:32]
+        with t.serve(
+            boundaries=(8, 16), max_batch=4, max_wait_s=0.01,
+            max_new_tokens=8,
+        ) as eng:
+            futs = [eng.submit(s) for s in texts]
+            outs = [f.result(timeout=120) for f in futs]
+            assert eng.recompiles_after_warmup == 0
+            assert eng.metrics.completed == 32
+            assert eng.pool.in_use == 0  # every slot freed on EOS
+        assert outs == t(texts, max_new_tokens=8)
+
+    def test_queue_rejects_when_saturated(self, tiny_translator):
+        t, texts = tiny_translator
+        eng = t.serve(
+            boundaries=(8, 16), max_batch=2, max_queue_depth=2,
+            max_new_tokens=4, start=False,
+        )
+        eng.start(warmup=False)  # cold engine: first batch compiles slowly,
+        try:                     # so the queue genuinely backs up
+            hits = 0
+            for i in range(40):
+                try:
+                    eng.submit(texts[i % len(texts)])
+                except Backpressure as e:
+                    hits += 1
+                    assert e.retry_after > 0
+            assert hits > 0
+            assert eng.metrics.rejected == hits
+        finally:
+            eng.stop()
+
+    def test_deadline_expiry_frees_slots_and_fails_future(
+        self, tiny_translator
+    ):
+        t, texts = tiny_translator
+        eng = t.serve(
+            boundaries=(8, 16), max_batch=2, max_new_tokens=4, start=False
+        )
+        eng.start(warmup=False)
+        try:
+            # deadline_s=0 is expired the instant it lands: the batcher's
+            # sweep must fail it without decoding it or taking a slot
+            req = eng.submit(texts[0], deadline_s=0.0)
+            with pytest.raises(DeadlineExceeded):
+                req.result(timeout=30)
+            assert eng.pool.in_use == 0
+            deadline = time.monotonic() + 10
+            while eng.metrics.expired < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert eng.metrics.expired == 1
+        finally:
+            eng.stop()
+
+    def test_oversized_input_rejected_at_submit(self, tiny_translator):
+        t, _ = tiny_translator
+        with t.serve(boundaries=(8,), max_batch=2, max_new_tokens=4) as eng:
+            with pytest.raises(ValueError, match="largest bucket boundary"):
+                eng.submit("w " * 30)
+
+    def test_stop_fails_queued_requests(self, tiny_translator):
+        from machine_learning_apache_spark_tpu.serving.engine import (
+            EngineStopped,
+        )
+
+        t, texts = tiny_translator
+        short = [s for s in texts if len(s.split()) <= 5][:3]
+        eng = t.serve(
+            boundaries=(8,), max_batch=8, max_wait_s=30.0, max_new_tokens=4,
+            start=False,
+        )
+        eng.start(warmup=False)
+        reqs = [eng.submit(s) for s in short]
+        eng.stop()
+        # 3 < max_batch and max_wait is 30s, so nothing shipped: every
+        # queued request must fail loudly, never hang
+        for r in reqs:
+            with pytest.raises(EngineStopped):
+                r.result(timeout=5)
+
+    def test_beam_method_serves(self, tiny_translator):
+        t, texts = tiny_translator
+        short = [s for s in texts if len(s.split()) <= 5][:4]
+        with t.serve(
+            boundaries=(8,), max_batch=2, max_new_tokens=4,
+            method="beam", beam_size=2,
+        ) as eng:
+            outs = [
+                f.result(timeout=120)
+                for f in [eng.submit(s) for s in short]
+            ]
+        assert outs == t(short, method="beam", beam_size=2, max_new_tokens=4)

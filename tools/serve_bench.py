@@ -1,0 +1,189 @@
+"""Serving throughput/latency bench — p50/p99 vs offered load.
+
+Drives the continuous-batching engine (``serving.ServingEngine``) with
+open-loop traffic at a sweep of offered request rates and reports, per
+level: achieved rate, completion/rejection counts, client-observed
+p50/p99 latency, and generated tokens/sec. The sweep self-calibrates —
+an unloaded batch is timed first, capacity ≈ max_batch / batch_latency,
+and load levels are fractions of it (0.25/0.5/1.0/1.5×) — so the same
+tool produces comparable curves on a laptop CPU or a chip.
+
+One engine serves the whole sweep (so the zero-recompile invariant is
+measured across it), one JSON line per level on stdout, and the full
+artifact lands in ``BENCH_SERVE_r01.json`` (same style as the
+``BENCH_r*.json`` round artifacts; ``--out`` relocates).
+
+Usage: JAX_PLATFORMS=cpu python tools/serve_bench.py [--smoke] [--out P]
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_translator(tiny: bool):
+    """Untrained tiny translator — the bench measures the serving layer
+    (batching, queueing, dispatch), not model quality."""
+    import jax
+    import numpy as np
+
+    from machine_learning_apache_spark_tpu.data.datasets import (
+        synthetic_translation_pairs,
+    )
+    from machine_learning_apache_spark_tpu.data.text import TextPipeline
+    from machine_learning_apache_spark_tpu.inference import Translator
+    from machine_learning_apache_spark_tpu.models import (
+        Transformer,
+        TransformerConfig,
+    )
+
+    pairs = synthetic_translation_pairs(256, min_len=3, max_len=8, seed=0)
+    src_pipe = TextPipeline.fit([s for s, _ in pairs], max_seq_len=14)
+    trg_pipe = TextPipeline.fit([t for _, t in pairs], max_seq_len=14)
+    d = 32 if tiny else 128
+    cfg = TransformerConfig(
+        src_vocab_size=len(src_pipe.vocab.itos),
+        trg_vocab_size=len(trg_pipe.vocab.itos),
+        d_model=d, ffn_hidden=2 * d, num_heads=4,
+        num_layers=1 if tiny else 2, max_len=16, dropout=0.0,
+    )
+    model = Transformer(cfg)
+    dummy = np.ones((2, 8), np.int32)
+    params = model.init(jax.random.key(0), dummy, dummy)["params"]
+    texts = [s for s, _ in pairs]
+    return Translator(model, params, src_pipe, trg_pipe), texts
+
+
+def run_level(engine, texts, rate: float, duration: float) -> dict:
+    """Open-loop: submit at ``rate`` req/s for ``duration`` seconds, then
+    drain. Client-observed latency via done-callbacks (submit→result)."""
+    from machine_learning_apache_spark_tpu.serving import Backpressure
+
+    latencies: list[float] = []
+    lock = threading.Lock()
+    rejected = expired = 0
+    pending = []
+    tokens_before = engine.metrics.tokens_out
+    interval = 1.0 / rate
+    t0 = time.monotonic()
+    n = 0
+    while (now := time.monotonic()) - t0 < duration:
+        try:
+            req = engine.submit(texts[n % len(texts)], deadline_s=duration)
+            submit_t = now
+
+            def on_done(fut, s=submit_t):
+                with lock:
+                    latencies.append(time.monotonic() - s)
+
+            req.future.add_done_callback(on_done)
+            pending.append(req)
+        except Backpressure:
+            rejected += 1
+        except ValueError:
+            pass  # over-boundary input; texts are pre-sized so: unreachable
+        n += 1
+        sleep_for = t0 + n * interval - time.monotonic()
+        if sleep_for > 0:
+            time.sleep(sleep_for)
+    for req in pending:
+        try:
+            req.result(timeout=duration + 10)
+        except Exception:  # noqa: BLE001 — expiry counts, doesn't abort
+            expired += 1
+    elapsed = time.monotonic() - t0
+    from machine_learning_apache_spark_tpu.serving.metrics import percentile
+
+    completed = len(pending) - expired
+    return {
+        "offered_rps": round(rate, 2),
+        "submitted": n,
+        "completed": completed,
+        "rejected": rejected,
+        "expired": expired,
+        "achieved_rps": round(completed / elapsed, 2),
+        "p50_latency_s": _r4(percentile(latencies, 50)),
+        "p99_latency_s": _r4(percentile(latencies, 99)),
+        "max_latency_s": _r4(max(latencies) if latencies else None),
+        "tokens_per_sec": round(
+            (engine.metrics.tokens_out - tokens_before) / elapsed, 1
+        ),
+    }
+
+
+def _r4(v):
+    return None if v is None else round(v, 4)
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    out_path = "BENCH_SERVE_r01.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    if smoke:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    translator, texts = build_translator(tiny=smoke)
+    knobs = dict(
+        boundaries=(8, 16), max_batch=8, max_wait_s=0.005,
+        max_queue_depth=128, max_new_tokens=10,
+    )
+    engine = translator.serve(**knobs)
+    duration = 2.0 if smoke else 10.0
+    with engine:
+        # Calibrate: one full batch through the (warmed) engine.
+        t0 = time.monotonic()
+        reqs = [engine.submit(texts[i]) for i in range(knobs["max_batch"])]
+        for r in reqs:
+            r.result(timeout=60)
+        batch_s = time.monotonic() - t0
+        capacity = knobs["max_batch"] / batch_s
+        print(json.dumps({
+            "calibration": {
+                "batch_s": _r4(batch_s),
+                "capacity_rps_est": round(capacity, 1),
+            }
+        }), flush=True)
+
+        fractions = (0.25, 1.0) if smoke else (0.25, 0.5, 1.0, 1.5)
+        rows = []
+        for frac in fractions:
+            rate = max(capacity * frac, 1.0)
+            row = {"load_fraction": frac, **run_level(
+                engine, texts, rate, duration
+            )}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+        artifact = {
+            "bench": "serve",
+            "smoke": smoke,
+            "platform": _platform(),
+            "engine": {k: list(v) if isinstance(v, tuple) else v
+                       for k, v in knobs.items()},
+            "duration_per_level_s": duration,
+            "calibration_capacity_rps": round(capacity, 1),
+            "rows": rows,
+            "recompiles_after_warmup": engine.recompiles_after_warmup,
+            "engine_summary": engine.metrics.summary(),
+        }
+    with open(out_path, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+    print(json.dumps({
+        "wrote": out_path,
+        "recompiles_after_warmup": artifact["recompiles_after_warmup"],
+    }), flush=True)
+
+
+def _platform() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+if __name__ == "__main__":
+    main()
