@@ -68,9 +68,11 @@ if _os.environ.get("MLSPARK_PLATFORM") or _os.environ.get("MLSPARK_CPU_DEVICES")
         if _os.environ.get("MLSPARK_PLATFORM"):
             _jax.config.update("jax_platforms", _os.environ["MLSPARK_PLATFORM"])
         if _os.environ.get("MLSPARK_CPU_DEVICES"):
-            _jax.config.update(
-                "jax_num_cpu_devices", int(_os.environ["MLSPARK_CPU_DEVICES"])
+            from machine_learning_apache_spark_tpu.utils.jax_compat import (
+                set_num_cpu_devices as _set_num_cpu_devices,
             )
+
+            _set_num_cpu_devices(int(_os.environ["MLSPARK_CPU_DEVICES"]))
 
 from machine_learning_apache_spark_tpu.session import Session, SessionBuilder
 

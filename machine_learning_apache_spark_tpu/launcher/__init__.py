@@ -9,6 +9,12 @@ from machine_learning_apache_spark_tpu.launcher.distributor import (
     Distributor,
     TorchDistributor,
     fn_reference,
+    kill_stray_gangs,
+)
+from machine_learning_apache_spark_tpu.launcher.monitor import (
+    GangFailure,
+    GangMonitor,
+    terminate_gang,
 )
 
 __all__ = [
@@ -18,4 +24,8 @@ __all__ = [
     "Distributor",
     "TorchDistributor",
     "fn_reference",
+    "GangFailure",
+    "GangMonitor",
+    "kill_stray_gangs",
+    "terminate_gang",
 ]

@@ -80,6 +80,17 @@ def initialize_from_env(conf: SessionConfig | None = None) -> RendezvousSpec | N
     spec = RendezvousSpec.from_env(conf)
     if spec is None or _initialized:
         return spec
+    # The CPU backend has no native cross-process collectives ("Multiprocess
+    # computations aren't implemented on the CPU backend") — gloo is its
+    # gloo. Opt in before the backend initializes so CPU gangs (the
+    # reference's local_mode bring-up path AND the fault-drill test gangs)
+    # can run real psums/allgathers; TPU backends ignore the setting.
+    platforms = os.environ.get("JAX_PLATFORMS", jax.config.jax_platforms or "")
+    if "cpu" in str(platforms).split(","):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 - older/newer jax: name moved/absent
+            pass
     jax.distributed.initialize(
         coordinator_address=spec.coordinator_address,
         num_processes=spec.num_processes,

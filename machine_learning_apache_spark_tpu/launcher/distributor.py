@@ -29,19 +29,66 @@ integration with an external scheduler; see ``commands_for_hosts``).
 
 from __future__ import annotations
 
+import atexit
 import os
 import pickle
+import random
+import signal
 import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Sequence
 
+from machine_learning_apache_spark_tpu.launcher.monitor import (
+    GangFailure,
+    GangMonitor,
+    terminate_gang,
+)
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+# Process groups of gangs this interpreter spawned and has not yet reaped.
+# Safety net against orphaned workers: the normal path unregisters after
+# reaping, and the atexit sweep (plus tests/conftest.py's session-finish
+# sweep) SIGKILLs whatever a crashed/interrupted driver left behind —
+# otherwise a timed-out pytest run leaves rogue ranks burning CPU past the
+# CI timeout.
+_LIVE_PGIDS: set[int] = set()
+_PGIDS_LOCK = threading.Lock()
+
+
+def _register_gang(procs: Sequence[subprocess.Popen]) -> None:
+    with _PGIDS_LOCK:
+        _LIVE_PGIDS.update(p.pid for p in procs)
+
+
+def _unregister_gang(procs: Sequence[subprocess.Popen]) -> None:
+    with _PGIDS_LOCK:
+        _LIVE_PGIDS.difference_update(p.pid for p in procs)
+
+
+def kill_stray_gangs() -> int:
+    """SIGKILL every registered-but-unreaped gang process group. Returns
+    the number of groups signalled (0 in any healthy run)."""
+    with _PGIDS_LOCK:
+        pgids, stray = list(_LIVE_PGIDS), len(_LIVE_PGIDS)
+        _LIVE_PGIDS.clear()
+    for pgid in pgids:
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            stray -= 1
+    if stray:
+        log.warning("killed %d stray gang process group(s)", stray)
+    return stray
+
+
+atexit.register(kill_stray_gangs)
 
 
 def _free_port() -> int:
@@ -103,6 +150,11 @@ class Distributor:
         env: dict[str, str] | None = None,
         timeout: float = 600.0,
         max_restarts: int = 0,
+        heartbeat_interval: float = 1.0,
+        heartbeat_timeout: float | None = 300.0,
+        term_grace: float = 5.0,
+        backoff_base: float = 0.5,
+        backoff_max: float = 30.0,
     ) -> None:
         self.num_processes = num_processes or 1
         self.local_mode = local_mode
@@ -112,6 +164,18 @@ class Distributor:
         # Spark-barrier recovery semantics (SURVEY.md §5 failure detection):
         # a failed stage is retried whole — all-or-nothing gang restarts.
         self.max_restarts = max_restarts
+        # Liveness detection (docs/FAULT_TOLERANCE.md): each worker touches
+        # a per-rank heartbeat file every `heartbeat_interval`; a rank silent
+        # past `heartbeat_timeout` is declared stalled and the gang torn
+        # down (None disables — exit codes and the deadline still apply).
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_timeout = heartbeat_timeout
+        # Teardown escalation: SIGTERM, wait `term_grace`, then SIGKILL.
+        self.term_grace = term_grace
+        # Restart pacing: exponential backoff with jitter, so co-failing
+        # gangs on one host don't re-stampede the same resource in lockstep.
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
 
     # -- multi-host control plane --------------------------------------------
     def commands_for_hosts(
@@ -157,23 +221,34 @@ class Distributor:
         try:
             attempt = 0
             while True:
-                # Clear any stale result files from a failed attempt so a
-                # restart can't return a dead rank's leftovers.
+                # Clear any stale result/heartbeat files from a failed
+                # attempt so a restart can't return a dead rank's leftovers
+                # (or judge liveness off a corpse's last beat).
                 for rank in range(n):
-                    stale = os.path.join(workdir, f"result_{rank}.pkl")
-                    if os.path.exists(stale):
-                        os.unlink(stale)
+                    for name in (f"result_{rank}.pkl", f"heartbeat_{rank}"):
+                        stale = os.path.join(workdir, name)
+                        if os.path.exists(stale):
+                            os.unlink(stale)
                 try:
-                    return self._run_gang(ref, coord, workdir, args_path, n)
-                except (RuntimeError, TimeoutError):
+                    return self._run_gang(
+                        ref, coord, workdir, args_path, n, attempt
+                    )
+                except GangFailure as failure:
                     attempt += 1
                     if attempt > self.max_restarts:
                         raise
+                    delay = min(
+                        self.backoff_max,
+                        self.backoff_base * (2 ** (attempt - 1)),
+                    ) * (0.5 + random.random() / 2)  # full-jitter-lite
                     log.warning(
-                        "gang attempt %d/%d failed; restarting whole gang "
-                        "(Spark-barrier all-or-nothing semantics)",
-                        attempt, self.max_restarts,
+                        "gang attempt %d/%d failed (rank=%s cause=%s); "
+                        "restarting whole gang in %.2fs (Spark-barrier "
+                        "all-or-nothing semantics)",
+                        attempt, self.max_restarts, failure.rank,
+                        failure.cause, delay,
                     )
+                    time.sleep(delay)
                     coord = f"127.0.0.1:{_free_port()}"  # stale port may linger
         finally:
             import shutil
@@ -181,18 +256,45 @@ class Distributor:
             shutil.rmtree(workdir, ignore_errors=True)
 
     def _run_gang(
-        self, ref: str, coord: str, workdir: str, args_path: str, n: int
+        self,
+        ref: str,
+        coord: str,
+        workdir: str,
+        args_path: str,
+        n: int,
+        attempt: int = 0,
     ) -> Any:
         procs: list[subprocess.Popen] = []
-        result_paths = []
+        result_paths, heartbeat_paths = [], []
         for rank in range(n):
             result_path = os.path.join(workdir, f"result_{rank}.pkl")
+            heartbeat_path = os.path.join(workdir, f"heartbeat_{rank}")
             result_paths.append(result_path)
+            heartbeat_paths.append(heartbeat_path)
             env = dict(os.environ)
+            # A driver running under the test harness carries
+            # --xla_force_host_platform_device_count in XLA_FLAGS (virtual
+            # multi-device CPU). Workers must NOT inherit it: the gang
+            # contract is one device per rank (world == num_processes), and
+            # an inherited 8x multiplier breaks every worker-side mesh.
+            # Explicit Distributor(env=...) still wins (applied below).
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" in flags:
+                kept = " ".join(
+                    f for f in flags.split()
+                    if "xla_force_host_platform_device_count" not in f
+                )
+                if kept:
+                    env["XLA_FLAGS"] = kept
+                else:
+                    env.pop("XLA_FLAGS", None)
             env.update(self.extra_env)
             env["MLSPARK_COORDINATOR"] = coord
             env["MLSPARK_NUM_PROCESSES"] = str(n)
             env["MLSPARK_PROCESS_ID"] = str(rank)
+            env["MLSPARK_GANG_ATTEMPT"] = str(attempt)
+            env["MLSPARK_HEARTBEAT_FILE"] = heartbeat_path
+            env["MLSPARK_HEARTBEAT_INTERVAL"] = str(self.heartbeat_interval)
             host, _, port = coord.partition(":")
             env["MASTER_ADDR"], env["MASTER_PORT"] = host, port
             env["WORLD_SIZE"], env["RANK"] = str(n), str(rank)
@@ -213,51 +315,80 @@ class Distributor:
                 "--args-file", args_path,
                 "--result-file", result_path,
             ]
-            procs.append(subprocess.Popen(cmd, env=env))
-        log.info("spawned %d-process gang (coordinator %s)", n, coord)
+            # start_new_session: each worker leads its own process group, so
+            # teardown signals reach the worker AND anything it spawned.
+            procs.append(
+                subprocess.Popen(cmd, env=env, start_new_session=True)
+            )
+        _register_gang(procs)
+        log.info(
+            "spawned %d-process gang (coordinator %s, attempt %d)",
+            n, coord, attempt,
+        )
 
-        deadline = time.monotonic() + self.timeout
         try:
-            self._wait_gang(procs, deadline)
+            failure = self._wait_gang(procs, heartbeat_paths)
         finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
+            # Belt and suspenders for non-GangFailure exits (KeyboardInterrupt
+            # etc.): nothing outlives the attempt.
+            terminate_gang(procs, grace=0.0)
+            _unregister_gang(procs)
 
         results = [self._read_result(path, rank) for rank, path in enumerate(result_paths)]
         errors = [r for r in results if r.error]
-        if errors:
-            # Ranks killed by the gang teardown leave placeholder errors;
-            # surface the rank that actually crashed (its real traceback).
-            primary = next(
-                (r for r in errors if "produced no result" not in r.error), errors[0]
-            )
-            raise RuntimeError(
-                "gang failed on rank(s) "
-                + ", ".join(str(r.rank) for r in errors)
-                + f":\n[rank {primary.rank}] {primary.error}"
-            )
-        return results[0].value
+        if failure is None and not errors:
+            return results[0].value
 
-    def _wait_gang(self, procs: list[subprocess.Popen], deadline: float) -> None:
-        """All-or-nothing barrier semantics: first nonzero exit kills the gang."""
-        pending = set(range(len(procs)))
-        while pending:
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"gang did not finish within {self.timeout}s; killing"
-                )
-            for rank in list(pending):
-                code = procs[rank].poll()
-                if code is None:
-                    continue
-                pending.discard(rank)
-                if code != 0:
-                    for p in procs:
-                        if p.poll() is None:
-                            p.kill()
-                    # fall through: result files carry the traceback
-            time.sleep(0.05)
+        # Ranks killed by the gang teardown leave placeholder errors;
+        # surface the rank that actually crashed (its real traceback). A
+        # rank with only a placeholder is an EFFECT of teardown, never the
+        # blamed cause — a deadline expiry, where every rank is healthy but
+        # slow, must keep rank=None.
+        real = next(
+            (r for r in errors if "produced no result" not in r.error), None
+        )
+        primary = real or (errors[0] if errors else None)
+        detail = (
+            f"\n[rank {primary.rank}] {primary.error}" if primary else ""
+        )
+        cause = failure.cause if failure is not None else "exit"
+        raise GangFailure(
+            "gang failed on rank(s) "
+            + (", ".join(str(r.rank) for r in errors) or "?")
+            + f" (cause={cause}, attempt={attempt})"
+            + (f": {failure}" if failure is not None else "")
+            + detail,
+            rank=(
+                failure.rank if failure is not None and failure.rank is not None
+                else (real.rank if real else None)
+            ),
+            cause=cause,
+            attempt=attempt,
+            exit_code=failure.exit_code if failure is not None else None,
+        )
+
+    def _wait_gang(
+        self,
+        procs: list[subprocess.Popen],
+        heartbeat_paths: list[str] | None = None,
+    ) -> GangFailure | None:
+        """All-or-nothing barrier semantics, delegated to a ``GangMonitor``
+        thread: the first nonzero exit, stalled heartbeat, or deadline
+        expiry tears the gang down (SIGTERM -> SIGKILL). Returns the
+        detected failure, or None if every rank exited 0."""
+        watcher = GangMonitor(
+            procs,
+            heartbeat_paths,
+            timeout=self.timeout,
+            heartbeat_timeout=self.heartbeat_timeout,
+            grace=self.term_grace,
+        )
+        watcher.start()
+        while watcher.is_alive():
+            # join with a timeout so the driver stays interruptible
+            # (Ctrl-C in a notebook must not wedge behind a daemon join).
+            watcher.join(timeout=1.0)
+        return watcher.failure
 
     @staticmethod
     def _resolve(fn: Callable | str) -> Callable:

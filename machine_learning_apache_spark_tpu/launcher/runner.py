@@ -11,7 +11,45 @@ import argparse
 import os
 import pickle
 import sys
+import threading
 import traceback
+
+
+def _start_heartbeat(path: str, interval: float) -> threading.Thread:
+    """Touch ``path`` every ``interval`` seconds from a daemon thread —
+    the liveness signal ``launcher.monitor.GangMonitor`` watches.
+
+    Started before the heavy framework imports so a wedged import counts
+    as the stall it is only after the full ``heartbeat_timeout``, not as
+    instant death. The beat loop holds no lock and touches nothing
+    shared, so it keeps beating through compiles and collectives (which
+    release the GIL); it stops only when the process truly wedges — or
+    when a ``stall`` fault suspends it to simulate exactly that.
+    """
+
+    def suspended() -> bool:
+        # sys.modules peek instead of an import: the faults module lives
+        # behind package __init__s that drag in jax, and this thread must
+        # stay stdlib-only. If user code never imported it, no stall
+        # fault can have fired.
+        mod = sys.modules.get("machine_learning_apache_spark_tpu.utils.faults")
+        return bool(mod is not None and mod.heartbeats_suspended())
+
+    def beat() -> None:
+        import time
+
+        while True:
+            if not suspended():
+                try:
+                    with open(path, "a"):
+                        os.utime(path)
+                except OSError:
+                    pass  # workdir tearing down — the gang is over anyway
+            time.sleep(interval)
+
+    t = threading.Thread(target=beat, name="mlspark-heartbeat", daemon=True)
+    t.start()
+    return t
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -33,6 +71,16 @@ def main(argv: list[str] | None = None) -> int:
         os.environ["MLSPARK_PROCESS_ID"] = str(ns.process_id)
 
     rank = int(os.environ.get("MLSPARK_PROCESS_ID", "0"))
+
+    # Liveness beacon for the driver's GangMonitor — started before the
+    # framework imports so rendezvous/import time is covered too.
+    heartbeat_file = os.environ.get("MLSPARK_HEARTBEAT_FILE")
+    if heartbeat_file:
+        _start_heartbeat(
+            heartbeat_file,
+            float(os.environ.get("MLSPARK_HEARTBEAT_INTERVAL", "1.0")),
+        )
+
     args, kwargs = ((), {})
     if ns.args_file:
         with open(ns.args_file, "rb") as f:

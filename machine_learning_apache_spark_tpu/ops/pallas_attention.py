@@ -30,6 +30,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from machine_learning_apache_spark_tpu.utils.jax_compat import (
+    pallas_tpu_compiler_params,
+)
+
 NEG_INF = -1e30
 
 
@@ -451,7 +455,7 @@ def _flash_backward(cfg, query, key, value, kv_valid, out, lse, g):
         pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
     ]
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = pallas_tpu_compiler_params(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
 
@@ -606,7 +610,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d_pad), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -194,9 +194,20 @@ def shard_state(
             # below replicates non-divisible dims LOUDLY, per its contract.
             p = P(DATA_AXIS, *tuple(p)[1:])
         name = jax.tree_util.keystr(path)
-        return jax.device_put(
-            x, _divisible_sharding(NamedSharding(mesh, p), x, name)
-        )
+        sharding = _divisible_sharding(NamedSharding(mesh, p), x, name)
+        if not sharding.is_fully_addressable:
+            # Multi-process mesh (a launcher gang): device_put rejects
+            # shardings spanning other hosts' devices. Every process holds
+            # the full host value here, so assemble the global array by
+            # giving each LOCAL device its slice — the standard multihost
+            # construction.
+            import numpy as np
+
+            arr = np.asarray(jax.device_get(x))
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx, a=arr: a[idx]
+            )
+        return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map_with_path(
         place, specs, unboxed,

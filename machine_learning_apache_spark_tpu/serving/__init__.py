@@ -12,7 +12,11 @@ latency/throughput ledger. Entry point: ``Translator.serve()``.
 """
 
 from machine_learning_apache_spark_tpu.serving.batcher import Batch, Batcher
-from machine_learning_apache_spark_tpu.serving.engine import ServingEngine
+from machine_learning_apache_spark_tpu.serving.engine import (
+    EngineStopped,
+    InternalError,
+    ServingEngine,
+)
 from machine_learning_apache_spark_tpu.serving.kv_slots import KVSlotPool
 from machine_learning_apache_spark_tpu.serving.metrics import (
     Histogram,
@@ -30,7 +34,9 @@ __all__ = [
     "Batch",
     "Batcher",
     "DeadlineExceeded",
+    "EngineStopped",
     "Histogram",
+    "InternalError",
     "KVSlotPool",
     "RequestQueue",
     "ServeRequest",

@@ -35,6 +35,7 @@ from machine_learning_apache_spark_tpu.serving.queue import (
     RequestQueue,
     ServeRequest,
 )
+from machine_learning_apache_spark_tpu.utils.faults import maybe_fault
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 from machine_learning_apache_spark_tpu.utils.profiling import annotate
 
@@ -43,6 +44,16 @@ log = get_logger(__name__)
 
 class EngineStopped(RuntimeError):
     """The engine shut down before this request completed."""
+
+
+class InternalError(RuntimeError):
+    """The engine failed this request internally (its decode batch raised).
+
+    The failure is *contained*: only the quarantined batch's requests see
+    this, the decode loop keeps serving, and — because the per-bucket
+    programs were compiled at warmup — recovery triggers zero recompiles.
+    The original exception rides along as ``__cause__``.
+    """
 
 
 class ServingEngine:
@@ -116,6 +127,9 @@ class ServingEngine:
         self._compiles_at_warmup: int | None = None
         self._stop = threading.Event()
         self._worker: threading.Thread | None = None
+        # Monotonic sequence over dispatched batches — the ``decode_batch``
+        # fault-injection coordinate (worker thread only; no lock needed).
+        self._batch_seq = 0
 
     def _make_decoder(self, beam_size: int, length_penalty: float):
         """One jitted decode callable (its own jit cache → per-bucket
@@ -240,6 +254,26 @@ class ServingEngine:
 
     # -- the decode loop -----------------------------------------------------
     def _serve_loop(self) -> None:
+        """Supervisor: keep a decode loop alive until ``stop()``.
+
+        Two containment rings (docs/FAULT_TOLERANCE.md). Inner: a batch
+        that raises is quarantined — its own requests fail with
+        ``InternalError``, everything else keeps flowing. Outer: if the
+        loop itself dies (batcher bug, quarantine path raising), it is
+        restarted here rather than leaving a silently dead engine whose
+        submitters all block until their deadlines; ``loop_restarts``
+        counts how often that safety net caught something.
+        """
+        while not self._stop.is_set():
+            try:
+                self._decode_loop()
+            except Exception:  # noqa: BLE001 — a dead loop, not a dead engine
+                if self._stop.is_set():
+                    break
+                log.exception("decode loop died; restarting")
+                self.metrics.on_loop_restart()
+
+    def _decode_loop(self) -> None:
         while not self._stop.is_set():
             batch = self.batcher.next_batch(timeout=0.05)
             if batch is None:
@@ -247,12 +281,25 @@ class ServingEngine:
             try:
                 self._run_batch(batch)
             except Exception as e:  # noqa: BLE001 — a batch must never kill the loop
-                log.info("serve batch failed: %r", e)
-                for r in batch.requests:
-                    self.pool.release_owner(r.id)
-                    if not r.future.done():
-                        r.future.set_exception(e)
-                self.metrics.on_failure(len(batch.requests))
+                self._quarantine(batch, e)
+
+    def _quarantine(self, batch: Batch, exc: Exception) -> None:
+        """Contain one failed batch: free its KV slots, fail its (and only
+        its) requests with ``InternalError``, and count it."""
+        log.info("quarantining batch of %d: %r", len(batch.requests), exc)
+        n = 0
+        for r in batch.requests:
+            self.pool.release_owner(r.id)
+            if not r.future.done():
+                err = InternalError(
+                    f"decode batch failed internally ({type(exc).__name__}); "
+                    "only this batch's requests are affected"
+                )
+                err.__cause__ = exc
+                r.future.set_exception(err)
+                n += 1
+        self.metrics.on_quarantine(n)
+        self.metrics.on_failure(n)
 
     def _take_slots(self, batch: Batch) -> list[ServeRequest]:
         """All-or-nothing slot acquisition for the batch's live members,
@@ -283,6 +330,11 @@ class ServingEngine:
         members = self._take_slots(batch)
         if not members:
             return
+        # After slot acquisition, before decode: an injected failure here
+        # exercises the full quarantine path, slot release included.
+        seq = self._batch_seq
+        self._batch_seq += 1
+        maybe_fault("decode_batch", batch=seq)
         batch_start = self.clock()
         src = np.full((self.max_batch, batch.boundary), self._pad_id, np.int32)
         for i, r in enumerate(members):

@@ -96,6 +96,12 @@ class ServingMetrics:
         self.rejected = 0
         self.expired = 0
         self.failed = 0
+        # containment counters (engine._quarantine / supervisor restart):
+        # quarantined ⊆ failed — requests failed by a contained batch
+        # fault; loop_restarts counts decode-loop deaths the supervisor
+        # caught. Both 0 in a healthy window.
+        self.quarantined = 0
+        self.loop_restarts = 0
         # throughput
         self.batches = 0
         self.tokens_out = 0
@@ -125,6 +131,14 @@ class ServingMetrics:
     def on_failure(self, n: int = 1) -> None:
         with self._lock:
             self.failed += n
+
+    def on_quarantine(self, n: int = 1) -> None:
+        with self._lock:
+            self.quarantined += n
+
+    def on_loop_restart(self) -> None:
+        with self._lock:
+            self.loop_restarts += 1
 
     def on_batch(
         self,
@@ -164,6 +178,8 @@ class ServingMetrics:
             "rejected": self.rejected,
             "expired": self.expired,
             "failed": self.failed,
+            "quarantined": self.quarantined,
+            "loop_restarts": self.loop_restarts,
             "batches": self.batches,
             "tokens_out": self.tokens_out,
             "tokens_per_sec": round(self.tokens_per_sec, 1),

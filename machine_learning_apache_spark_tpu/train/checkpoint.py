@@ -15,16 +15,62 @@ why ``restore`` takes a template state built by ``TrainState.create``.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from machine_learning_apache_spark_tpu.train.state import TrainState
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+LATEST_POINTER = "latest"  # <dir>/latest — JSON {"step": N}
+
+
+def _per_rank_multiprocessing_options():
+    """Inside a jax.distributed gang, each rank checkpoints to its OWN
+    directory, so its manager must form a single-process orbax group:
+    ``active_processes={rank}`` routes every barrier through the
+    coordination-service client (works on any backend) instead of
+    ``sync_global_devices`` — an XLA collective the CPU backend cannot
+    execute — and ``primary_host=rank`` makes each rank responsible for
+    creating/renaming under its own directory. Orbax defaults outside a
+    gang."""
+    if jax.process_count() <= 1:
+        return ocp.options.MultiprocessingOptions()
+    rank = jax.process_index()
+    return ocp.options.MultiprocessingOptions(
+        primary_host=rank,
+        active_processes={rank},
+        barrier_sync_key_prefix=f"rank{rank}",
+    )
+
+
+def _detach_local(x):
+    """numpy view of a rank-local array. Orbax refuses jax.Arrays that are
+    fully addressable while ``process_count > 1`` ("host local" — it can't
+    tell them from a half-visible global array), but a per-rank checkpoint
+    is EXACTLY a host-local state dump, so detaching to numpy is the
+    correct serialization, not a workaround. Non-addressable (genuinely
+    global) arrays pass through for orbax's sharded writer."""
+    if isinstance(x, jax.Array) and x.is_fully_addressable:
+        return np.asarray(jax.device_get(x))
+    return x
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    """Write-then-rename: readers see the old file or the new file, never
+    a torn one — the invariant resume correctness rides on."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 class CheckpointManager:
@@ -33,20 +79,52 @@ class CheckpointManager:
     >>> ckpt = CheckpointManager(dir, max_to_keep=3)
     >>> ckpt.save(state)                       # step taken from state.step
     >>> state, step = ckpt.restore(template)   # latest by default
+
+    Crash-consistency layer (docs/FAULT_TOLERANCE.md): alongside orbax's
+    own atomic step directories, ``save`` maintains
+
+    - ``meta_<step>.json`` — small sidecar (epoch counter, host rng key)
+      written atomically, so a resumed ``fit`` continues the *epoch loop
+      and rng stream*, not just the params;
+    - ``latest`` — an atomically-replaced pointer naming the newest step
+      whose data AND sidecar are both durable. The pointer is advanced
+      only after ``wait_until_finished`` confirms the async write
+      landed, so it always names a *complete* checkpoint — a worker
+      killed mid-save leaves the pointer on the previous step.
+
+    ``restore_latest_valid`` walks steps newest-first (pointer target
+    first) and falls back past any checkpoint that fails to load —
+    corrupt or partial data costs one checkpoint interval, never the run.
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
         self._last_saved: int | None = None
+        # Steps whose orbax save was issued but whose durability (and so
+        # pointer advance) hasn't been confirmed yet: [(step, meta)].
+        self._unpointed: list[tuple[int, dict]] = []
+        # Root dir is made here, not by orbax (`create=True` is rejected
+        # when `active_processes` narrows the group): every rank owns its
+        # own directory, so plain makedirs is race-free.
+        os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+                max_to_keep=max_to_keep,
+                create=False,
+                multiprocessing_options=_per_rank_multiprocessing_options(),
             ),
         )
 
     # -- write ---------------------------------------------------------------
-    def save(self, state: TrainState, *, step: int | None = None, wait: bool = True) -> int:
+    def save(
+        self,
+        state: TrainState,
+        *,
+        step: int | None = None,
+        wait: bool = True,
+        meta: dict | None = None,
+    ) -> int:
         step = int(state.step if step is None else step)
         # Saving the same step twice WITHIN this run (e.g. a zero-batch epoch
         # leaves state.step unchanged, then the epoch-end hook fires again)
@@ -56,6 +134,12 @@ class CheckpointManager:
         if step == self._last_saved:
             log.info("checkpoint step %d already saved this run; skipping", step)
             return step
+        # Advance the pointer over any prior async save before starting the
+        # next one: wait_until_finished here is cheap (the previous save has
+        # had a whole checkpoint interval to complete in the background).
+        if self._unpointed:
+            self._mgr.wait_until_finished()
+            self._flush_pointer()
         if step in self._mgr.all_steps():
             log.info("overwriting stale checkpoint step %d from a prior run", step)
             self._mgr.delete(step)
@@ -65,11 +149,60 @@ class CheckpointManager:
             "params": state.params,
             "opt_state": state.opt_state,
         }
+        if jax.process_count() > 1:
+            payload = jax.tree.map(_detach_local, payload)
         self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        self._unpointed.append((step, dict(meta or {})))
         if wait:
             self._mgr.wait_until_finished()
+            self._flush_pointer()
         log.info("checkpoint step %d -> %s", step, self.directory)
         return step
+
+    def _flush_pointer(self) -> None:
+        """Sidecars + pointer for every save confirmed durable. Called only
+        after ``wait_until_finished`` — ordering is the correctness."""
+        if not self._unpointed:
+            return
+        for step, meta in self._unpointed:
+            _atomic_write_json(self._meta_path(step), meta)
+        newest = max(step for step, _ in self._unpointed)
+        _atomic_write_json(
+            os.path.join(self.directory, LATEST_POINTER), {"step": newest}
+        )
+        self._unpointed.clear()
+        # Retention hygiene: drop sidecars whose step orbax already pruned.
+        live = set(self._mgr.all_steps())
+        for name in os.listdir(self.directory):
+            if name.startswith("meta_") and name.endswith(".json"):
+                try:
+                    s = int(name[len("meta_"):-len(".json")])
+                except ValueError:
+                    continue
+                if s not in live:
+                    try:
+                        os.unlink(os.path.join(self.directory, name))
+                    except OSError:
+                        pass
+
+    def _meta_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"meta_{step}.json")
+
+    def read_meta(self, step: int) -> dict:
+        """The sidecar saved with ``step`` ({} if absent/unreadable)."""
+        try:
+            with open(self._meta_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def pointed_step(self) -> int | None:
+        """The ``latest`` pointer's target, or None (no pointer / torn)."""
+        try:
+            with open(os.path.join(self.directory, LATEST_POINTER)) as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
 
     # -- read ----------------------------------------------------------------
     def latest_step(self) -> int | None:
@@ -91,9 +224,28 @@ class CheckpointManager:
             "params": template.params,
             "opt_state": template.opt_state,
         }
-        payload = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(target)
-        )
+        if jax.process_count() > 1:
+            # Mirror of the save path: restore through a numpy target, then
+            # put each leaf back onto the template's devices/sharding.
+            payload = self._mgr.restore(
+                step,
+                args=ocp.args.StandardRestore(
+                    jax.tree.map(_detach_local, target)
+                ),
+            )
+            payload = jax.tree.map(
+                lambda restored, orig: (
+                    jax.device_put(restored, orig.sharding)
+                    if isinstance(orig, jax.Array) and orig.is_fully_addressable
+                    else restored
+                ),
+                payload,
+                target,
+            )
+        else:
+            payload = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target)
+            )
         state = template.replace(
             step=payload["step"],
             params=payload["params"],
@@ -102,12 +254,46 @@ class CheckpointManager:
         log.info("restored checkpoint step %d from %s", step, self.directory)
         return state, step
 
+    def restore_latest_valid(
+        self, template: TrainState
+    ) -> tuple[TrainState, int, dict] | None:
+        """Restore the newest checkpoint that actually loads.
+
+        Candidate order: the ``latest`` pointer's step first (the newest
+        one known COMPLETE), then every other on-disk step newest-first —
+        so a corrupt or partial checkpoint (worker killed mid-save, torn
+        disk) costs one checkpoint interval, not the run. Returns
+        ``(state, step, meta)``, or None when nothing on disk restores.
+        """
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        pointed = self.pointed_step()
+        if pointed in steps:
+            steps.remove(pointed)
+            steps.insert(0, pointed)
+        for step in steps:
+            try:
+                state, _ = self.restore(template, step=step)
+            except Exception as e:  # noqa: BLE001 - any load failure → fall back
+                log.warning(
+                    "checkpoint step %d failed to restore (%r); falling "
+                    "back to the previous one", step, e,
+                )
+                continue
+            return state, step, self.read_meta(step)
+        return None
+
     def wait(self) -> None:
-        """Block until in-flight async saves are durable."""
+        """Block until in-flight async saves are durable (and the
+        ``latest`` pointer acknowledges them)."""
         self._mgr.wait_until_finished()
+        self._flush_pointer()
 
     def close(self) -> None:
-        self._mgr.close()
+        try:
+            self._mgr.wait_until_finished()
+            self._flush_pointer()
+        finally:
+            self._mgr.close()
 
     def __enter__(self) -> "CheckpointManager":
         return self

@@ -100,10 +100,24 @@ class FitResult:
     state: TrainState
     train_seconds: float
     history: list[dict] = field(default_factory=list)
+    # Step the run auto-resumed from (fit(resume=True) found a valid
+    # checkpoint); None for a fresh run.
+    resumed_step: int | None = None
 
     @property
     def final_loss(self) -> float:
         return self.history[-1]["loss"] if self.history else float("nan")
+
+
+def _rng_to_meta(rng: jax.Array) -> list[int]:
+    """Host-serializable form of a PRNG key for the checkpoint sidecar."""
+    import numpy as np
+
+    return np.asarray(jax.device_get(jax.random.key_data(rng))).tolist()
+
+
+def _rng_from_meta(data: list[int]) -> jax.Array:
+    return jax.random.wrap_key_data(jnp.asarray(data, dtype=jnp.uint32))
 
 
 def fit(
@@ -125,6 +139,7 @@ def fit(
     zero1: bool = False,
     steps_per_call: int = 1,
     prefetch_to_device: int = 0,
+    resume: bool = False,
 ) -> FitResult:
     """The canonical loop (``pytorch_cnn.py:125-146`` shape): epochs × batches,
     per-``log_every``-batch loss/time prints
@@ -166,6 +181,16 @@ def fit(
     front of each dispatch. Combine with the loader's host-side
     ``prefetch`` for a fully double-buffered input pipeline.
 
+    ``resume=True`` (with a ``checkpointer``) restores the newest valid
+    checkpoint before training and continues the run from it: params and
+    opt-state from the checkpoint, epoch counter and rng stream from its
+    sidecar meta (docs/FAULT_TOLERANCE.md). The epoch loop then runs only
+    the remaining epochs and the rng stream picks up exactly where the
+    interrupted run left it, so a resumed trajectory is bit-identical to
+    an uninterrupted one from the last checkpoint onward. No checkpoint
+    on disk -> a normal fresh run; ``FitResult.resumed_step`` records
+    which happened.
+
     The input ``state``'s buffers are CONSUMED (the fused step donates them
     for in-place updates); use ``FitResult.state``, never the argument,
     afterwards. Build from copied params if two fits must share an init.
@@ -198,6 +223,23 @@ def fit(
         # the optimizer moments over.
         raise ValueError("zero1=True requires a mesh (use_mesh=True)")
 
+    resumed_step: int | None = None
+    resume_meta: dict = {}
+    start_epoch = 0
+    if resume and checkpointer is not None:
+        # After shard_state so the restore template carries the run's real
+        # layout — orbax restores straight into the sharded buffers.
+        restored = checkpointer.restore_latest_valid(state)
+        if restored is not None:
+            state, resumed_step, resume_meta = restored
+            if "rng" in resume_meta:
+                rng = _rng_from_meta(resume_meta["rng"])
+            start_epoch = int(resume_meta.get("epoch", -1)) + 1
+            emit(
+                f"resuming from checkpoint step {resumed_step} "
+                f"(starting epoch {start_epoch})"
+            )
+
     from machine_learning_apache_spark_tpu.train.metrics import MetricsLogger
 
     # Rank-0 gated like every other metrics emission (utils.logging): a
@@ -215,13 +257,21 @@ def fit(
                 state, step_fn, train_loader, epochs, rng, mesh, log_every,
                 emit, tracer, checkpointer, checkpoint_every, span_timer, sink,
                 sync_check_every, multi_fn, steps_per_call,
-                prefetch_to_device,
+                prefetch_to_device, start_epoch,
+                int(resumed_step) if resumed_step is not None else 0,
             )
         finally:
             # An exception mid-window must still stop the (process-global)
             # jax profiler, or every later trace in this process fails to
             # start.
             tracer.close()
+        if not history and resume_meta.get("metrics"):
+            # Already-complete resume (a gang retry where THIS rank had
+            # finished before teardown): zero epochs remain, so report the
+            # final epoch's metrics recorded in the checkpoint sidecar —
+            # the caller's loss-parity checks must hold on every retried
+            # rank, including the ones with nothing left to do.
+            history = [dict(resume_meta["metrics"])]
         # Block on the final state so the reported wall-time includes device
         # work (the reference's time.time() pairs measure eager CPU
         # execution; under async dispatch the analogue requires a sync point).
@@ -240,19 +290,23 @@ def fit(
         if sink is not None:
             sink.close()
     emit(f"Training Time: {seconds:.3f} sec")
-    return FitResult(state=state, train_seconds=seconds, history=history)
+    return FitResult(
+        state=state, train_seconds=seconds, history=history,
+        resumed_step=resumed_step,
+    )
 
 
 def _run_epochs(
     state, step_fn, train_loader, epochs, rng, mesh, log_every, emit,
     tracer, checkpointer, checkpoint_every, span_timer, sink=None,
     sync_check_every=0, multi_fn=None, steps_per_call=1,
-    prefetch_to_device=0,
+    prefetch_to_device=0, start_epoch=0, start_step=0,
 ):
     from machine_learning_apache_spark_tpu.parallel.mesh import (
         device_prefetch,
         shard_batch_stack,
     )
+    from machine_learning_apache_spark_tpu.utils.faults import maybe_fault
 
     # Device prefetch applies to the single-step path: sharded transfers
     # are issued N batches ahead so they overlap compute. The scanned path
@@ -263,9 +317,12 @@ def _run_epochs(
     )
 
     history: list[dict] = []
-    global_step = 0
+    # On resume the step counter continues from the restored checkpoint, so
+    # step-pinned coordinates (profiler windows, injected faults, log lines)
+    # mean the same thing in a resumed run as in an uninterrupted one.
+    global_step = start_step
     last_emit_step = global_step
-    for epoch in range(epochs):
+    for epoch in range(start_epoch, epochs):
         if hasattr(train_loader, "set_epoch"):
             train_loader.set_epoch(epoch)
         epoch_metrics = MetricBundle()
@@ -315,6 +372,12 @@ def _run_epochs(
             )
             tracer.on_step(global_step)
             prev = global_step
+            # The scanned dispatch covers steps [prev, prev+K): check every
+            # coordinate in the span so a step-pinned fault fires regardless
+            # of steps_per_call (at group granularity — the whole group is
+            # lost, which is within the <=1-checkpoint-interval guarantee).
+            for s in range(prev, prev + len(group)):
+                maybe_fault("train_step", step=s)
             state, rng, losses, auxes = multi_fn(state, stacked, rng)
             global_step += len(group)
             pending.append((
@@ -332,6 +395,7 @@ def _run_epochs(
                 batch = shard_batch(mesh, batch)
             rng, step_rng = jax.random.split(rng)
             tracer.on_step(global_step)
+            maybe_fault("train_step", step=global_step)
             state, loss, aux = step_fn(state, batch, step_rng)
             global_step += 1
             pending.append((loss, aux, 1))
@@ -379,8 +443,22 @@ def _run_epochs(
             (epoch + 1) % max(checkpoint_every, 1) == 0 or epoch == epochs - 1
         ):
             # Async: orbax snapshots to host and writes in the background, so
-            # checkpoint I/O never stalls device dispatch mid-training.
-            checkpointer.save(state, wait=False)
+            # checkpoint I/O never stalls device dispatch mid-training. The
+            # sidecar meta carries the epoch counter and the post-epoch rng
+            # key so fit(resume=True) continues the exact trajectory.
+            checkpointer.save(
+                state, wait=False,
+                meta={
+                    "epoch": epoch,
+                    "rng": _rng_to_meta(rng),
+                    # JSON-safe copy of this epoch's metrics, so an
+                    # already-complete resume can still report them.
+                    "metrics": {
+                        k: (v if isinstance(v, int) else float(v))
+                        for k, v in computed.items()
+                    },
+                },
+            )
     return state, history
 
 

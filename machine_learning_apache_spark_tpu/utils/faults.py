@@ -1,0 +1,261 @@
+"""Deterministic fault injection — the chaos the robustness layer is tested by.
+
+Fault tolerance that has never seen a fault is a comment, not a feature.
+This module gives every crash-containment path in the repo (gang
+restart, checkpoint resume, serving quarantine, heartbeat detection) a
+deterministic trigger: a *plan* of faults, each pinned to an exact site
+and coordinate ("crash rank 1 at train step 5", "raise in decode batch
+2", "stall rank 0's heartbeats at step 3"), installed either
+programmatically (tests) or through the environment (spawned gang
+workers, the fault drill).
+
+Grammar (``MLSPARK_FAULTS``, semicolon-separated)::
+
+    action@site:key=value,key=value;action@site:...
+
+    crash@train_step:rank=1,step=5     # os._exit(23) — a hard kill
+    raise@decode_batch:batch=2         # raise FaultInjected in the engine
+    stall@train_step:rank=0,step=3     # suspend heartbeats + hang
+
+Sites are the instrumented ``maybe_fault(site, ...)`` call points:
+``train_step`` (train.loop, per optimizer step) and ``decode_batch``
+(serving.engine, per formed batch). ``rank`` matches
+``MLSPARK_PROCESS_ID`` (absent -> matches any process).
+
+**One-shot semantics.** A fault fires once. In-process that's a set of
+fired keys; across process restarts (the gang-retry case — the retried
+worker re-executes the same step numbers) it's a marker file under
+``MLSPARK_FAULTS_DIR``, written *before* the action so even an
+``os._exit`` can't re-arm itself. Without a marker dir, ``crash``/
+``stall`` faults would re-fire on every gang attempt and no retry could
+ever succeed — ``FaultPlan.from_env`` therefore logs a warning when a
+crash/stall plan has no marker dir.
+
+The hot-path cost when no plan is installed is one global ``is None``
+check in ``maybe_fault``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+
+
+def _log():
+    # Lazy: utils.logging imports jax (rank gating), and this module must
+    # stay stdlib-importable — the runner's heartbeat thread polls
+    # heartbeats_suspended() before the worker's JAX platform is settled.
+    from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+    return get_logger(__name__)
+
+
+ENV_PLAN = "MLSPARK_FAULTS"
+ENV_MARKER_DIR = "MLSPARK_FAULTS_DIR"
+
+_ACTIONS = ("crash", "raise", "stall")
+
+
+class FaultInjected(RuntimeError):
+    """An injected failure (the ``raise`` action) — never raised by real
+    code paths, so tests can assert provenance."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One planned fault: fire ``action`` at ``site`` when every given
+    coordinate matches (``None`` = wildcard)."""
+
+    action: str
+    site: str
+    rank: int | None = None
+    step: int | None = None
+    batch: int | None = None
+    exit_code: int = 23
+
+    @property
+    def key(self) -> str:
+        """Stable marker-file name for one-shot bookkeeping."""
+        return (
+            f"{self.action}_{self.site}"
+            f"_r{'any' if self.rank is None else self.rank}"
+            f"_s{'any' if self.step is None else self.step}"
+            f"_b{'any' if self.batch is None else self.batch}"
+        )
+
+    def matches(self, site: str, rank: int | None, step: int | None,
+                batch: int | None) -> bool:
+        if self.site != site:
+            return False
+        for want, got in ((self.rank, rank), (self.step, step), (self.batch, batch)):
+            if want is not None and want != got:
+                return False
+        return True
+
+
+class FaultPlan:
+    """An installed set of ``FaultSpec``s with one-shot bookkeeping."""
+
+    def __init__(self, specs: list[FaultSpec], *, marker_dir: str | None = None):
+        self.specs = list(specs)
+        self.marker_dir = marker_dir
+        self._fired: set[str] = set()
+        self._lock = threading.Lock()
+
+    # -- parsing -------------------------------------------------------------
+    @classmethod
+    def from_spec(cls, text: str, *, marker_dir: str | None = None) -> "FaultPlan":
+        specs = []
+        for entry in filter(None, (e.strip() for e in text.split(";"))):
+            action, _, rest = entry.partition("@")
+            if action not in _ACTIONS:
+                raise ValueError(
+                    f"unknown fault action {action!r} in {entry!r} "
+                    f"(expected one of {_ACTIONS})"
+                )
+            site, _, kvs = rest.partition(":")
+            if not site:
+                raise ValueError(f"fault entry {entry!r} has no site")
+            fields: dict = {"action": action, "site": site}
+            for kv in filter(None, (p.strip() for p in kvs.split(","))):
+                k, _, v = kv.partition("=")
+                if k not in ("rank", "step", "batch", "exit_code"):
+                    raise ValueError(f"unknown fault field {k!r} in {entry!r}")
+                fields[k] = int(v)
+            specs.append(FaultSpec(**fields))
+        return cls(specs, marker_dir=marker_dir)
+
+    @classmethod
+    def from_env(cls, environ=os.environ) -> "FaultPlan | None":
+        text = environ.get(ENV_PLAN)
+        if not text:
+            return None
+        plan = cls.from_spec(text, marker_dir=environ.get(ENV_MARKER_DIR))
+        if plan.marker_dir is None and any(
+            s.action in ("crash", "stall") for s in plan.specs
+        ):
+            _log().warning(
+                "%s has crash/stall faults but no %s marker dir: they will "
+                "re-fire on every process restart (gang retries cannot "
+                "succeed)", ENV_PLAN, ENV_MARKER_DIR,
+            )
+        return plan
+
+    # -- one-shot bookkeeping ------------------------------------------------
+    def _already_fired(self, spec: FaultSpec) -> bool:
+        if spec.key in self._fired:
+            return True
+        return bool(
+            self.marker_dir
+            and os.path.exists(os.path.join(self.marker_dir, spec.key))
+        )
+
+    def _mark_fired(self, spec: FaultSpec) -> None:
+        self._fired.add(spec.key)
+        if self.marker_dir:
+            # Marker lands BEFORE the action: an os._exit fault must not be
+            # able to re-arm on the retried attempt. Atomic rename so a kill
+            # mid-write can't leave a half-marker.
+            os.makedirs(self.marker_dir, exist_ok=True)
+            tmp = os.path.join(self.marker_dir, f".{spec.key}.tmp.{os.getpid()}")
+            with open(tmp, "w") as f:
+                f.write(str(time.time()))
+            os.replace(tmp, os.path.join(self.marker_dir, spec.key))
+
+    def pending(self, site: str, *, rank: int | None = None,
+                step: int | None = None, batch: int | None = None) -> FaultSpec | None:
+        """The first matching not-yet-fired spec, or None. Marks it fired."""
+        with self._lock:
+            for spec in self.specs:
+                if spec.matches(site, rank, step, batch) and not self._already_fired(spec):
+                    self._mark_fired(spec)
+                    return spec
+        return None
+
+
+# -- process-global plan ------------------------------------------------------
+_PLAN: FaultPlan | None = None
+_PLAN_LOADED = False
+_HEARTBEATS_SUSPENDED = threading.Event()
+
+
+def install(plan: FaultPlan | None) -> None:
+    """Install (or, with None, clear) the process-global plan — the test
+    hook; spawned workers get theirs from the environment instead."""
+    global _PLAN, _PLAN_LOADED
+    _PLAN = plan
+    _PLAN_LOADED = True
+    if plan is None:
+        _HEARTBEATS_SUSPENDED.clear()
+
+
+def clear() -> None:
+    install(None)
+    global _PLAN_LOADED
+    _PLAN_LOADED = False  # next maybe_fault re-reads the environment
+
+
+def active_plan() -> FaultPlan | None:
+    """The installed plan, lazily falling back to ``MLSPARK_FAULTS``."""
+    global _PLAN, _PLAN_LOADED
+    if not _PLAN_LOADED:
+        _PLAN = FaultPlan.from_env()
+        _PLAN_LOADED = True
+    return _PLAN
+
+
+def heartbeats_suspended() -> bool:
+    """True once a ``stall`` fault fired — the runner's heartbeat thread
+    polls this so a stalled worker goes silent exactly like a hung one."""
+    return _HEARTBEATS_SUSPENDED.is_set()
+
+
+def _env_rank() -> int | None:
+    v = os.environ.get("MLSPARK_PROCESS_ID")
+    return int(v) if v is not None else None
+
+
+def maybe_fault(site: str, *, step: int | None = None,
+                batch: int | None = None, rank: int | None = None) -> None:
+    """Instrumentation point: fire the first pending fault matching this
+    site/coordinate, else return immediately. ``rank`` defaults to this
+    process's ``MLSPARK_PROCESS_ID``."""
+    plan = active_plan()
+    if plan is None:
+        return
+    spec = plan.pending(
+        site, rank=_env_rank() if rank is None else rank, step=step, batch=batch
+    )
+    if spec is None:
+        return
+    _log().warning("fault injection firing: %s (site=%s step=%s batch=%s)",
+                spec.key, site, step, batch)
+    if spec.action == "raise":
+        raise FaultInjected(f"injected fault {spec.key}")
+    if spec.action == "crash":
+        # os._exit: no atexit, no finally, no result file — the closest
+        # in-process stand-in for SIGKILL/OOM/preemption.
+        os._exit(spec.exit_code)
+    if spec.action == "stall":
+        # Go silent: heartbeats stop (the monitor's missed-heartbeat path
+        # must notice), and this thread hangs until the gang teardown's
+        # SIGTERM/SIGKILL reaps the process.
+        _HEARTBEATS_SUSPENDED.set()
+        while True:
+            time.sleep(3600)
+
+
+__all__ = [
+    "ENV_MARKER_DIR",
+    "ENV_PLAN",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
+    "active_plan",
+    "clear",
+    "heartbeats_suspended",
+    "install",
+    "maybe_fault",
+]

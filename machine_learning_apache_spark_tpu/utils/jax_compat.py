@@ -1,0 +1,99 @@
+"""Version-skew shims for the narrow band of JAX API the repo spans.
+
+The hosting images pin different jax releases (0.4.37 today; newer
+elsewhere), and two surfaces we depend on moved between them:
+
+- ``shard_map`` graduated from ``jax.experimental.shard_map.shard_map``
+  to ``jax.shard_map``. Same signature for the keyword form we use
+  (``mesh=/in_specs=/out_specs=``).
+- The pallas-TPU compiler-params dataclass was renamed
+  ``TPUCompilerParams`` -> ``CompilerParams`` inside
+  ``jax.experimental.pallas.tpu``; the fields we pass
+  (``dimension_semantics``) are unchanged.
+
+Every caller imports the symbol from here instead of version-guessing
+inline, so the next rename is a one-file fix.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+    # The graduated shard_map tracks replication through transposition:
+    # differentiating a replicated (in_spec P()) input automatically
+    # psums its cotangents across the mesh, so grads come back as the
+    # true global reduction with no explicit collective.
+    implicit_replicated_grad_reduce = True
+else:  # jax <= 0.4.x: pre-graduation home
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    # 0.4.x forces a trade: its replication checker can't statically
+    # infer that transpose-inserted psums leave grads replicated (it
+    # rejects data_parallel's P() grads out_spec outright), and turning
+    # the checker off ALSO turns off the replication-aware transpose
+    # rewrite — cotangents of replicated inputs are NOT psummed. So the
+    # shim disables the checker, and callers that differentiate through
+    # shard_map must consult the flag below and reduce grads themselves.
+    implicit_replicated_grad_reduce = False
+
+    def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):  # type: ignore[no-redef]
+        kwargs.setdefault("check_rep", False)
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+
+def pcast_varying(x, axes):
+    """``jax.lax.pcast(x, axes, to="varying")`` where it exists.
+
+    On pre-vma jax (<= 0.4.x) there is no varying/replicated type system
+    to satisfy — the fallback ``shard_map`` above runs ``check_rep=False``
+    — so the cast is semantically the identity.
+    """
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axes), to="varying")
+    return x
+
+
+def set_num_cpu_devices(n: int) -> None:
+    """Request ``n`` virtual CPU devices, under either mechanism.
+
+    ``jax_num_cpu_devices`` is the config option on current jax; 0.4.x
+    predates it, where the only lever is the
+    ``--xla_force_host_platform_device_count`` XLA flag. Both act only
+    BEFORE backend initialization — same contract as the caller had with
+    the plain config update.
+    """
+    import os
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n}".strip()
+            )
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """Build the pallas-TPU ``compiler_params`` object under either name.
+
+    Imported lazily: pallas drags in the Mosaic lowering stack, which not
+    every process touching this module needs (e.g. the launcher).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+__all__ = [
+    "shard_map",
+    "implicit_replicated_grad_reduce",
+    "pcast_varying",
+    "pallas_tpu_compiler_params",
+    "set_num_cpu_devices",
+]

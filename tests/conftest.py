@@ -51,3 +51,18 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Sweep stray gang process groups at session end: a launcher test
+    that timed out or crashed mid-gang must not leave orphaned ranks
+    burning CPU past the pytest run (they would also hold the session's
+    coordinator ports open). No-op (returns 0) in any healthy run."""
+    del session, exitstatus
+    try:
+        from machine_learning_apache_spark_tpu.launcher.distributor import (
+            kill_stray_gangs,
+        )
+    except Exception:
+        return  # collection-only / broken-import runs have nothing to sweep
+    kill_stray_gangs()

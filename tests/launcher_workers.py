@@ -37,6 +37,15 @@ def unpicklable_result():
     return lambda: None  # cannot cross the result-file boundary
 
 
+def sleep_forever():
+    """Never returns (but keeps heartbeating) — only the gang deadline
+    can end this worker."""
+    import time
+
+    while True:
+        time.sleep(0.25)
+
+
 def cross_process_sum():
     """Verifies jax.distributed actually rendezvoused: allgather each rank's
     value and sum — the collective path the reference delegates to gloo."""
@@ -112,6 +121,69 @@ def dp_train_step_parity():
         "losses": losses,
         "fingerprint": params_fingerprint(state.params),
         "divergence": divergence,
+    }
+
+
+def fault_drill_train(workdir, epochs=4, checkpoint_every=1):
+    """Restart-safe training workload for the fault drill: deterministic
+    per-rank MLP training with per-rank checkpoint dirs and
+    ``fit(resume=True)``. When the gang is killed mid-run (an injected
+    crash/stall on one rank) and retried, every rank resumes from its last
+    complete checkpoint and the final loss must match an unfaulted run —
+    the tentpole's loss-parity acceptance check. Per-rank checkpoint dirs:
+    local-process orbax needs no cross-rank coordination, and the drill
+    asserts every rank independently recovers."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from machine_learning_apache_spark_tpu.models import MLP
+    from machine_learning_apache_spark_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from machine_learning_apache_spark_tpu.train.loop import fit
+    from machine_learning_apache_spark_tpu.train.losses import cross_entropy
+    from machine_learning_apache_spark_tpu.train.state import (
+        TrainState,
+        make_optimizer,
+    )
+
+    rank = jax.process_index()
+    rng = np.random.default_rng(7)
+    feats = rng.normal(size=(32, 4)).astype(np.float32)
+    labels = rng.integers(0, 3, 32).astype(np.int64)
+    loader = [
+        (feats[i * 8 : (i + 1) * 8], labels[i * 8 : (i + 1) * 8])
+        for i in range(4)
+    ]
+
+    model = MLP(layers=(4, 8, 3))
+    params = model.init(jax.random.key(0), jnp.ones((1, 4)))["params"]
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_optimizer("sgd", 0.1)
+    )
+
+    def loss_fn(p, batch, step_rng):
+        del step_rng
+        x, y = batch
+        return cross_entropy(model.apply({"params": p}, x), y), {}
+
+    with CheckpointManager(os.path.join(workdir, f"ckpt_r{rank}")) as ckpt:
+        res = fit(
+            state, loss_fn, loader,
+            epochs=epochs,
+            checkpointer=ckpt,
+            checkpoint_every=checkpoint_every,
+            resume=True,
+            log_every=0,
+        )
+    return {
+        "rank": rank,
+        "final_loss": res.final_loss,
+        "resumed_step": res.resumed_step,
+        "epochs_run": len(res.history),
     }
 
 

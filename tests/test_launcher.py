@@ -162,6 +162,58 @@ class TestDistributorLocal:
         )
 
 
+class TestFailureDetection:
+    """The monitor/teardown layer's contract: every way a gang dies maps
+    to a structured GangFailure (rank, cause, attempt), never a hang."""
+
+    def test_nonzero_exit_structured_failure(self):
+        from machine_learning_apache_spark_tpu.launcher import GangFailure
+
+        with pytest.raises(GangFailure) as ei:
+            Distributor(num_processes=2, platform="cpu", timeout=120).run(
+                "launcher_workers:boom"
+            )
+        assert ei.value.cause == "exit"
+        assert ei.value.attempt == 0
+        assert ei.value.rank in (0, 1)
+        assert "worker exploded" in str(ei.value)  # real traceback attached
+
+    def test_gang_deadline_expiry(self):
+        """Workers that never finish (but never die, and keep
+        heartbeating) must be ended by the gang deadline — cause
+        'deadline', no rank to blame."""
+        from machine_learning_apache_spark_tpu.launcher import GangFailure
+
+        with pytest.raises(GangFailure) as ei:
+            Distributor(
+                num_processes=2, platform="cpu", timeout=10, term_grace=1.0
+            ).run("launcher_workers:sleep_forever")
+        assert ei.value.cause == "deadline"
+        assert ei.value.rank is None
+
+    def test_restart_exhaustion_keeps_structured_fields(self):
+        from machine_learning_apache_spark_tpu.launcher import GangFailure
+
+        with pytest.raises(GangFailure) as ei:
+            Distributor(
+                num_processes=2, platform="cpu", timeout=120,
+                max_restarts=1, backoff_base=0.05,
+            ).run("launcher_workers:boom")
+        assert ei.value.attempt == 1  # the exhausting (last) attempt
+
+    def test_read_result_missing_file(self, tmp_path):
+        r = Distributor._read_result(str(tmp_path / "absent.pkl"), rank=3)
+        assert r.rank == 3
+        assert "produced no result" in r.error
+
+    def test_read_result_corrupt_file(self, tmp_path):
+        p = tmp_path / "result_0.pkl"
+        p.write_bytes(b"\x80\x04garbage")
+        r = Distributor._read_result(str(p), rank=0)
+        assert r.rank == 0
+        assert "produced no result" in r.error  # unreadable == no result
+
+
 class TestCommandsForHosts:
     def test_command_lines(self):
         cmds = Distributor(local_mode=False).commands_for_hosts(

@@ -29,9 +29,19 @@ EXAMPLES = [
 ]
 
 _BOOTSTRAP = """\
+import os
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    # Pre-import fallback for jax builds without jax_num_cpu_devices.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # covered by the XLA flag above
 import runpy, sys
 sys.path.insert(0, "examples")
 name = sys.argv[1]

@@ -1,0 +1,240 @@
+"""Fault drill — run the injection scenarios end to end, emit FAULTS_r01.json.
+
+The executable form of docs/FAULT_TOLERANCE.md: each scenario arms a
+deterministic fault plan (``utils.faults``), runs the real subsystem
+against it, and records what the robustness layer did about it:
+
+- ``gang_crash_resume`` — a 2-process training gang loses rank 1 to an
+  injected hard crash (``os._exit``) mid-run; the Distributor must
+  detect it (exit path), tear the gang down, retry it whole, and the
+  retried run must resume from checkpoints and land on the SAME final
+  loss as an unfaulted run.
+- ``gang_stall`` — rank 1 goes silent (heartbeats suspended + hang); the
+  heartbeat monitor must detect the stall (no exit code ever comes),
+  and the structured failure must name the rank and cause.
+- ``serving_poison`` — decode batch 0 raises; only its requests may
+  fail (``InternalError``), the loop keeps serving, zero recompiles.
+
+Usage::
+
+    python tools/fault_drill.py [--out FAULTS_r01.json] [scenario ...]
+
+Exits nonzero if any scenario's invariant does not hold, so CI can gate
+on the drill the way it gates on the test suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"),
+)
+
+from machine_learning_apache_spark_tpu.utils import faults  # noqa: E402
+
+
+def _with_plan(plan: str, marker_dir: str):
+    os.environ[faults.ENV_PLAN] = plan
+    os.environ[faults.ENV_MARKER_DIR] = marker_dir
+    faults.clear()  # re-arm the lazy env read in THIS process too
+
+
+def _clear_plan():
+    os.environ.pop(faults.ENV_PLAN, None)
+    os.environ.pop(faults.ENV_MARKER_DIR, None)
+    faults.clear()
+
+
+def scenario_gang_crash_resume(workdir: str) -> dict:
+    import launcher_workers
+
+    from machine_learning_apache_spark_tpu.launcher import Distributor
+
+    t0 = time.monotonic()
+    ref = launcher_workers.fault_drill_train(os.path.join(workdir, "ref"))
+
+    plan = "crash@train_step:rank=1,step=9"
+    markers = os.path.join(workdir, "markers")
+    _with_plan(plan, markers)
+    try:
+        out = Distributor(
+            num_processes=2, platform="cpu", timeout=300, max_restarts=1,
+            backoff_base=0.05, term_grace=2.0,
+        ).run(
+            "launcher_workers:fault_drill_train", os.path.join(workdir, "gang")
+        )
+    finally:
+        _clear_plan()
+    fired = sorted(os.listdir(markers)) if os.path.isdir(markers) else []
+    loss_delta = abs(out["final_loss"] - ref["final_loss"])
+    return {
+        "scenario": "gang_crash_resume",
+        "plan": plan,
+        "fault_fired": fired,
+        "unfaulted_final_loss": ref["final_loss"],
+        "drilled_final_loss": out["final_loss"],
+        "loss_delta": loss_delta,
+        "rank0_resumed_step": out["resumed_step"],
+        "wall_seconds": round(time.monotonic() - t0, 2),
+        "ok": bool(fired) and loss_delta < 1e-6,
+    }
+
+
+def scenario_gang_stall(workdir: str) -> dict:
+    from machine_learning_apache_spark_tpu.launcher import (
+        Distributor,
+        GangFailure,
+    )
+
+    plan = "stall@train_step:rank=1,step=2"
+    t0 = time.monotonic()
+    _with_plan(plan, os.path.join(workdir, "markers"))
+    failure = None
+    try:
+        Distributor(
+            num_processes=2, platform="cpu", timeout=300,
+            heartbeat_interval=0.2, heartbeat_timeout=4.0, term_grace=1.0,
+        ).run(
+            "launcher_workers:fault_drill_train", os.path.join(workdir, "gang")
+        )
+    except GangFailure as e:
+        failure = e
+    finally:
+        _clear_plan()
+    return {
+        "scenario": "gang_stall",
+        "plan": plan,
+        "detected": failure is not None,
+        "cause": failure.cause if failure else None,
+        "rank": failure.rank if failure else None,
+        "wall_seconds": round(time.monotonic() - t0, 2),
+        "ok": failure is not None
+        and failure.cause == "heartbeat"
+        and failure.rank == 1,
+    }
+
+
+def scenario_serving_poison(workdir: str) -> dict:
+    del workdir
+    import jax
+    import numpy as np
+
+    from machine_learning_apache_spark_tpu.data.datasets import (
+        synthetic_translation_pairs,
+    )
+    from machine_learning_apache_spark_tpu.data.text import TextPipeline
+    from machine_learning_apache_spark_tpu.inference import Translator
+    from machine_learning_apache_spark_tpu.models import (
+        Transformer,
+        TransformerConfig,
+    )
+    from machine_learning_apache_spark_tpu.serving import InternalError
+
+    t0 = time.monotonic()
+    pairs = synthetic_translation_pairs(32, min_len=3, max_len=8, seed=0)
+    src_pipe = TextPipeline.fit([s for s, _ in pairs], max_seq_len=14)
+    trg_pipe = TextPipeline.fit([t for _, t in pairs], max_seq_len=14)
+    cfg = TransformerConfig(
+        src_vocab_size=len(src_pipe.vocab.itos),
+        trg_vocab_size=len(trg_pipe.vocab.itos),
+        d_model=32, ffn_hidden=64, num_heads=2, num_layers=1,
+        max_len=16, dropout=0.0,
+    )
+    model = Transformer(cfg)
+    dummy = np.ones((2, 8), np.int32)
+    params = model.init(jax.random.key(0), dummy, dummy)["params"]
+    translator = Translator(model, params, src_pipe, trg_pipe)
+
+    plan = "raise@decode_batch:batch=0"
+    faults.install(faults.FaultPlan.from_spec(plan))
+    texts = [s for s, _ in pairs][:12]
+    try:
+        with translator.serve(
+            boundaries=(8, 16), max_batch=4, max_wait_s=0.01,
+            max_new_tokens=8,
+        ) as eng:
+            futs = [eng.submit(s) for s in texts]
+            served = failed = 0
+            for f in futs:
+                try:
+                    f.result(timeout=120)
+                    served += 1
+                except InternalError:
+                    failed += 1
+            summary = eng.metrics.summary()
+            recompiles = eng.recompiles_after_warmup
+            slots_leaked = eng.pool.in_use
+    finally:
+        faults.clear()
+    return {
+        "scenario": "serving_poison",
+        "plan": plan,
+        "submitted": len(texts),
+        "served": served,
+        "poisoned": failed,
+        "quarantined": summary["quarantined"],
+        "loop_restarts": summary["loop_restarts"],
+        "recompiles_after_warmup": recompiles,
+        "kv_slots_leaked": slots_leaked,
+        "wall_seconds": round(time.monotonic() - t0, 2),
+        "ok": (
+            0 < failed <= 4
+            and served == len(texts) - failed
+            and summary["quarantined"] == failed
+            and summary["loop_restarts"] == 0
+            and recompiles == 0
+            and slots_leaked == 0
+        ),
+    }
+
+
+SCENARIOS = {
+    "gang_crash_resume": scenario_gang_crash_resume,
+    "gang_stall": scenario_gang_stall,
+    "serving_poison": scenario_serving_poison,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--out", default="FAULTS_r01.json")
+    ap.add_argument(
+        "scenarios", nargs="*", default=None,
+        help=f"subset to run (default: all of {sorted(SCENARIOS)})",
+    )
+    ns = ap.parse_args()
+    names = ns.scenarios or sorted(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        ap.error(f"unknown scenario(s) {unknown}; pick from {sorted(SCENARIOS)}")
+
+    results = []
+    for name in names:
+        print(f"== drill: {name}", flush=True)
+        with tempfile.TemporaryDirectory(prefix=f"fault_drill_{name}_") as wd:
+            results.append(SCENARIOS[name](wd))
+        print(json.dumps(results[-1], indent=2), flush=True)
+
+    report = {
+        "artifact": "FAULTS",
+        "round": 1,
+        "all_ok": all(r["ok"] for r in results),
+        "scenarios": results,
+    }
+    with open(ns.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"wrote {ns.out} (all_ok={report['all_ok']})")
+    return 0 if report["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
