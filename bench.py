@@ -1391,6 +1391,11 @@ def main() -> None:
         result["error"] = repr(e)
         print(json.dumps(result))
         return
+    # Imported only after backend init (the package __init__ is heavy);
+    # every stage below runs under a bench.<label> span. With
+    # MLSPARK_TELEMETRY=0 these are shared no-op context managers — the
+    # stage timings are unaffected (the <2% train-step criterion).
+    from machine_learning_apache_spark_tpu import telemetry
     # The two workloads degrade independently: a transformer failure must
     # not suppress the CNN measurement, and vice versa. Exception: once any
     # deadline fires, its abandoned thread may STILL be running on the chip
@@ -1435,7 +1440,8 @@ def main() -> None:
             return _with_deadline(work, d, label)
 
         try:
-            return _transient_retry(attempt, label)
+            with telemetry.span(f"bench.{label}"):
+                return _transient_retry(attempt, label)
         except _BudgetExhausted:
             return {"skipped": "total budget"}
         except Exception as e:
@@ -1448,12 +1454,13 @@ def main() -> None:
         # ledger clamps its deadline instead, with a 300s floor so the
         # measurement can still land.
         head_d = max(min(deadline, _budget_left()), 300.0)
-        mt = _transient_retry(
-            lambda: _with_deadline(
-                lambda: bench_transformer(jax), head_d, "transformer"
-            ),
-            "transformer",
-        )
+        with telemetry.span("bench.transformer"):
+            mt = _transient_retry(
+                lambda: _with_deadline(
+                    lambda: bench_transformer(jax), head_d, "transformer"
+                ),
+                "transformer",
+            )
         baseline = bench_torch_transformer()
         result["value"] = mt["median"]
         result["vs_baseline"] = round(mt["median"] / baseline, 3) if baseline else 1.0
@@ -1529,12 +1536,13 @@ def main() -> None:
         else:
             sweep_points: list = []
             try:
-                result["sweep"] = _with_deadline(
-                    lambda: bench_transformer_sweep(
-                        jax, sweep_points, stop_at=time.monotonic() + d
-                    ),
-                    d + 60, "sweep",
-                )
+                with telemetry.span("bench.sweep"):
+                    result["sweep"] = _with_deadline(
+                        lambda: bench_transformer_sweep(
+                            jax, sweep_points, stop_at=time.monotonic() + d
+                        ),
+                        d + 60, "sweep",
+                    )
             except Exception as e:
                 log(traceback.format_exc())
                 # Snapshot: the abandoned thread could still append
@@ -1555,10 +1563,11 @@ def main() -> None:
         # the per-workload deadline — never skipped outright, floored so
         # the measurement can still land.
         cnn_d = max(min(deadline, _budget_left(reserve=0.0)), 120.0)
-        cnn = _transient_retry(
-            lambda: _with_deadline(lambda: bench_cnn(jax), cnn_d, "cnn"),
-            "cnn",
-        )
+        with telemetry.span("bench.cnn"):
+            cnn = _transient_retry(
+                lambda: _with_deadline(lambda: bench_cnn(jax), cnn_d, "cnn"),
+                "cnn",
+            )
         cnn_base = bench_torch_cnn()
         cnn["vs_baseline"] = (
             round(cnn["value"] / cnn_base, 3) if cnn_base else 1.0

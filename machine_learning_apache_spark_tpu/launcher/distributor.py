@@ -43,6 +43,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Sequence
 
+from machine_learning_apache_spark_tpu import telemetry
 from machine_learning_apache_spark_tpu.launcher.monitor import (
     GangFailure,
     GangMonitor,
@@ -230,11 +231,23 @@ class Distributor:
                         if os.path.exists(stale):
                             os.unlink(stale)
                 try:
-                    return self._run_gang(
-                        ref, coord, workdir, args_path, n, attempt
-                    )
+                    with telemetry.span(
+                        "launcher.gang_attempt",
+                        attempt=attempt, num_processes=n,
+                    ):
+                        value = self._run_gang(
+                            ref, coord, workdir, args_path, n, attempt
+                        )
+                    self._write_telemetry_report(workdir)
+                    return value
                 except GangFailure as failure:
                     attempt += 1
+                    telemetry.annotate(
+                        "launcher.gang_retry" if attempt <= self.max_restarts
+                        else "launcher.gang_exhausted",
+                        attempt=attempt, rank=failure.rank,
+                        cause=failure.cause,
+                    )
                     if attempt > self.max_restarts:
                         raise
                     delay = min(
@@ -254,6 +267,44 @@ class Distributor:
             import shutil
 
             shutil.rmtree(workdir, ignore_errors=True)
+
+    def _telemetry_out_dir(self, workdir: str) -> str:
+        """Where this gang's telemetry files land — the same precedence the
+        worker env gets in ``_run_gang`` (explicit env= > inherited env >
+        the ephemeral workdir)."""
+        return (
+            self.extra_env.get("MLSPARK_TELEMETRY_DIR")
+            or os.environ.get("MLSPARK_TELEMETRY_DIR")
+            or workdir
+        )
+
+    def _write_telemetry_report(self, workdir: str) -> None:
+        """Rank-0-side gang merge: after a successful run, fold the per-rank
+        ``telemetry_rank<k>.jsonl`` exports into ``telemetry_report.json``
+        (+ ``.md``) in the telemetry dir. Best-effort — reporting must never
+        fail a run that trained fine."""
+        if not telemetry.enabled():
+            return
+        try:
+            tdir = self._telemetry_out_dir(workdir)
+            from machine_learning_apache_spark_tpu.telemetry import aggregate
+
+            if not aggregate.find_rank_files(tdir):
+                return
+            report = aggregate.merge_gang_dir(tdir)
+            import json
+
+            with open(os.path.join(tdir, "telemetry_report.json"), "w") as f:
+                json.dump(report, f, indent=2)
+                f.write("\n")
+            with open(os.path.join(tdir, "telemetry_report.md"), "w") as f:
+                f.write(aggregate.render_markdown(report))
+            log.info(
+                "telemetry report merged from %d rank(s) into %s",
+                len(report["ranks"]), tdir,
+            )
+        except Exception:
+            log.exception("telemetry report generation failed (ignored)")
 
     def _run_gang(
         self,
@@ -289,6 +340,11 @@ class Distributor:
                 else:
                     env.pop("XLA_FLAGS", None)
             env.update(self.extra_env)
+            # Workers default their telemetry output (rank JSONLs, flight
+            # dumps) next to the heartbeat files; an inherited or explicit
+            # MLSPARK_TELEMETRY_DIR (e.g. a persistent dir from the fault
+            # drill) wins — the workdir is ephemeral (rmtree'd below).
+            env.setdefault("MLSPARK_TELEMETRY_DIR", workdir)
             env["MLSPARK_COORDINATOR"] = coord
             env["MLSPARK_NUM_PROCESSES"] = str(n)
             env["MLSPARK_PROCESS_ID"] = str(rank)

@@ -32,6 +32,7 @@ import subprocess
 import threading
 import time
 
+from machine_learning_apache_spark_tpu import telemetry
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -191,6 +192,26 @@ class GangMonitor(threading.Thread):
             if failure is not None:
                 self.failure = failure
                 log.warning("gang failure detected: %s", failure)
+                telemetry.annotate(
+                    "launcher.gang_failure",
+                    rank=failure.rank, cause=failure.cause,
+                    exit_code=failure.exit_code,
+                )
+                # Driver-side flight dump (flight_driver.json): what the
+                # driver observed around the failure. Falls back to the
+                # heartbeat dir (the gang workdir) when no telemetry dir is
+                # configured — next to the files that triggered detection.
+                tdir = telemetry.telemetry_dir() or (
+                    os.path.dirname(self.heartbeat_paths[0])
+                    if self.heartbeat_paths else None
+                )
+                telemetry.dump_flight(
+                    f"launcher.gang_failure:{failure.cause}",
+                    directory=tdir,
+                    extra={"rank": failure.rank, "cause": failure.cause,
+                           "exit_code": failure.exit_code},
+                )
+                telemetry.annotate("launcher.gang_teardown")
                 terminate_gang(self.procs, grace=self.grace)
                 return
             if pending:

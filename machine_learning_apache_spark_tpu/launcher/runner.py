@@ -52,6 +52,31 @@ def _start_heartbeat(path: str, interval: float) -> threading.Thread:
     return t
 
 
+def _install_sigterm_flight(tm, rank: int) -> None:
+    """On the gang teardown's SIGTERM, dump this worker's flight recorder
+    and export its rank timeline before dying with the default disposition
+    — the innocent ranks of a failed gang ship their last events too.
+    Best-effort: a worker without a main-thread signal context keeps the
+    default handler."""
+    import signal
+
+    def handler(signum, frame):  # noqa: ARG001
+        try:
+            tm.dump_flight("launcher.sigterm")
+            tdir = tm.telemetry_dir()
+            if tdir and tm.enabled():
+                tm.write_rank_file(tdir, rank=rank)
+        except Exception:
+            pass
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+    except (ValueError, OSError):  # non-main thread / exotic host
+        pass
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--fn", required=True, help="module:qualname")
@@ -88,6 +113,7 @@ def main(argv: list[str] | None = None) -> int:
 
     result: dict = {"rank": rank, "value": None, "error": None}
     code = 0
+    tm = None  # telemetry module, bound after platform config
     try:
         # Platform choice must go through the config API: the hosting image's
         # sitecustomize registers the axon TPU plugin in every process and
@@ -98,6 +124,12 @@ def main(argv: list[str] | None = None) -> int:
             import jax
 
             jax.config.update("jax_platforms", platform)
+
+        # Telemetry comes up only now: importing it pulls the package
+        # __init__ (heavy), which must not precede the platform override.
+        from machine_learning_apache_spark_tpu import telemetry as tm
+
+        _install_sigterm_flight(tm, rank)
 
         # Rendezvous before user code touches devices — the
         # dist.init_process_group analogue (distributed_cnn.py:152).
@@ -111,11 +143,27 @@ def main(argv: list[str] | None = None) -> int:
             resolve_fn,
         )
 
-        result["value"] = resolve_fn(ns.fn)(*args, **kwargs)
+        with tm.span(
+            "launcher.worker", fn=ns.fn, rank=rank,
+            attempt=int(os.environ.get("MLSPARK_GANG_ATTEMPT", "0")),
+        ):
+            result["value"] = resolve_fn(ns.fn)(*args, **kwargs)
     except BaseException:  # noqa: BLE001 - worker must report, not die silently
         result["error"] = traceback.format_exc()
         code = 1
+        if tm is not None:
+            tm.dump_flight("launcher.worker_exception")
     finally:
+        # Per-rank timeline export (telemetry_rank<k>.jsonl, next to the
+        # heartbeat files unless MLSPARK_TELEMETRY_DIR points elsewhere) —
+        # the input to telemetry.aggregate / tools/telemetry_report.py.
+        if tm is not None and tm.enabled():
+            tdir = tm.telemetry_dir()
+            if tdir:
+                try:
+                    tm.write_rank_file(tdir, rank=rank)
+                except Exception:
+                    traceback.print_exc()
         if ns.result_file:
             from machine_learning_apache_spark_tpu.launcher.distributor import (
                 WorkerResult,

@@ -26,6 +26,7 @@ from typing import Sequence
 import jax
 import numpy as np
 
+from machine_learning_apache_spark_tpu import telemetry
 from machine_learning_apache_spark_tpu.data.text import EOS_ID, SOS_ID
 from machine_learning_apache_spark_tpu.serving.batcher import Batch, Batcher
 from machine_learning_apache_spark_tpu.serving.kv_slots import KVSlotPool
@@ -175,6 +176,9 @@ class ServingEngine:
         self._worker = None
         n = self.queue.fail_all(EngineStopped("serving engine stopped"))
         if n:
+            # Counted into ``failed`` so the conservation law balances
+            # across shutdown: stop-drained requests are terminal too.
+            self.metrics.on_failure(n)
             log.info("engine stop failed %d queued requests", n)
 
     def __enter__(self) -> "ServingEngine":
@@ -244,12 +248,16 @@ class ServingEngine:
                 f"bucket boundary {self.boundaries[-1]}; raise boundaries "
                 "or shorten the input"
             )
-        try:
-            req = self.queue.submit(text, ids, deadline_s=deadline_s)
-        except Exception:
-            self.metrics.on_reject()
-            raise
+        # Count the attempt BEFORE the queue decides: the conservation law
+        # (metrics.check_conservation) needs every admission attempt in
+        # ``submitted`` so rejected ones balance against ``rejected``.
         self.metrics.on_submit()
+        with telemetry.span("serving.submit"):
+            try:
+                req = self.queue.submit(text, ids, deadline_s=deadline_s)
+            except Exception:
+                self.metrics.on_reject()
+                raise
         return req
 
     # -- the decode loop -----------------------------------------------------
@@ -287,6 +295,11 @@ class ServingEngine:
         """Contain one failed batch: free its KV slots, fail its (and only
         its) requests with ``InternalError``, and count it."""
         log.info("quarantining batch of %d: %r", len(batch.requests), exc)
+        telemetry.annotate(
+            "serving.quarantine",
+            boundary=batch.boundary, requests=len(batch.requests),
+            error=type(exc).__name__,
+        )
         n = 0
         for r in batch.requests:
             self.pool.release_owner(r.id)
@@ -300,6 +313,12 @@ class ServingEngine:
                 n += 1
         self.metrics.on_quarantine(n)
         self.metrics.on_failure(n)
+        # Flight recorder: the quarantined batch's decode span (errored) and
+        # the annotation above are the newest events in the dump.
+        telemetry.dump_flight(
+            f"serving.quarantine:{type(exc).__name__}",
+            extra={"boundary": batch.boundary, "requests_failed": n},
+        )
 
     def _take_slots(self, batch: Batch) -> list[ServeRequest]:
         """All-or-nothing slot acquisition for the batch's live members,
@@ -321,12 +340,22 @@ class ServingEngine:
                 break
             if self.pool.acquire_many([r.id for r in members], timeout=0.05):
                 return members
+        n_failed = 0
         for r in members:  # engine stopping
             if not r.future.done():
                 r.future.set_exception(EngineStopped("engine stopping"))
+                n_failed += 1
+        if n_failed:
+            self.metrics.on_failure(n_failed)  # terminal — conservation
         return []
 
     def _run_batch(self, batch: Batch) -> None:
+        with telemetry.span(
+            "serving.batch", boundary=batch.boundary, size=len(batch.requests)
+        ):
+            self._run_batch_inner(batch)
+
+    def _run_batch_inner(self, batch: Batch) -> None:
         members = self._take_slots(batch)
         if not members:
             return

@@ -28,9 +28,19 @@ import math
 import threading
 import time
 
+from machine_learning_apache_spark_tpu.telemetry import (
+    registry as telemetry_registry,
+)
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+class ConservationError(AssertionError):
+    """The serving admission ledger does not balance — a request was
+    admitted and then lost without being completed, rejected, expired, or
+    failed. This is the bug class the ledger exists to make impossible to
+    miss."""
 
 
 def percentile(samples: list[float], p: float) -> float | None:
@@ -114,31 +124,49 @@ class ServingMetrics:
         self.batch_occupancy = Histogram("batch_occupancy")
         self.slot_occupancy = Histogram("slot_occupancy")
         self.queue_depth = Histogram("queue_depth")
+        # Mirror the admission counters into the process-global telemetry
+        # registry (no-op singletons when MLSPARK_TELEMETRY=0). The registry
+        # is cumulative across engines in one process — the Prometheus view;
+        # this ledger stays per-engine.
+        reg = telemetry_registry.get_registry()
+        self._reg_counters = {
+            name: reg.counter("serving", name)
+            for name in (
+                "submitted", "completed", "rejected", "expired", "failed",
+                "quarantined", "loop_restarts", "batches", "tokens_out",
+            )
+        }
 
     # -- event hooks ---------------------------------------------------------
     def on_submit(self) -> None:
         with self._lock:
             self.submitted += 1
+        self._reg_counters["submitted"].inc()
 
     def on_reject(self) -> None:
         with self._lock:
             self.rejected += 1
+        self._reg_counters["rejected"].inc()
 
     def on_expire(self, n: int = 1) -> None:
         with self._lock:
             self.expired += n
+        self._reg_counters["expired"].inc(n)
 
     def on_failure(self, n: int = 1) -> None:
         with self._lock:
             self.failed += n
+        self._reg_counters["failed"].inc(n)
 
     def on_quarantine(self, n: int = 1) -> None:
         with self._lock:
             self.quarantined += n
+        self._reg_counters["quarantined"].inc(n)
 
     def on_loop_restart(self) -> None:
         with self._lock:
             self.loop_restarts += 1
+        self._reg_counters["loop_restarts"].inc()
 
     def on_batch(
         self,
@@ -153,6 +181,8 @@ class ServingMetrics:
         with self._lock:
             self.batches += 1
             self.tokens_out += new_tokens
+        self._reg_counters["batches"].inc()
+        self._reg_counters["tokens_out"].inc(new_tokens)
         self.batch_latency.record(decode_s)
         self.batch_occupancy.record(n_requests / max_batch)
         self.queue_depth.record(queue_depth)
@@ -161,9 +191,45 @@ class ServingMetrics:
     def on_complete(self, *, queue_wait: float, ttft: float, total: float) -> None:
         with self._lock:
             self.completed += 1
+        self._reg_counters["completed"].inc()
         self.queue_wait.record(queue_wait)
         self.ttft.record(ttft)
         self.total_latency.record(total)
+
+    # -- invariants ----------------------------------------------------------
+    def check_conservation(self, *, in_flight: int = 0) -> dict:
+        """Assert the admission conservation law::
+
+            submitted == completed + rejected + expired + failed + in_flight
+
+        Every admission attempt increments ``submitted`` (the engine counts
+        BEFORE the queue decides), so each must end in exactly one terminal
+        bucket — ``failed`` includes the quarantined and engine-stop
+        failures. ``in_flight`` is the caller's count of requests still
+        being worked (0 after a full drain). Raises ``ConservationError``
+        with the full ledger on imbalance; returns the ledger otherwise.
+        """
+        with self._lock:
+            ledger = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "failed": self.failed,
+                "quarantined": self.quarantined,
+                "in_flight": in_flight,
+            }
+        accounted = (
+            ledger["completed"] + ledger["rejected"] + ledger["expired"]
+            + ledger["failed"] + in_flight
+        )
+        if ledger["submitted"] != accounted:
+            raise ConservationError(
+                f"serving conservation violated: submitted "
+                f"{ledger['submitted']} != completed + rejected + expired "
+                f"+ failed + in_flight = {accounted} ({ledger})"
+            )
+        return ledger
 
     # -- reporting -----------------------------------------------------------
     @property

@@ -26,6 +26,8 @@ import time
 from concurrent.futures import Future
 from typing import Sequence
 
+from machine_learning_apache_spark_tpu.telemetry import events as telemetry_events
+
 _REQUEST_IDS = itertools.count()
 
 
@@ -123,6 +125,11 @@ class RequestQueue:
             self._expire_locked(now)
             if len(self._pending) >= self.max_depth:
                 self.rejected += 1
+                # Cold path (admission already refused): the event is a
+                # breadcrumb for the flight recorder, not a hot-loop cost.
+                telemetry_events.annotate(
+                    "serving.queue.reject", depth=len(self._pending)
+                )
                 raise Backpressure(
                     len(self._pending),
                     self._service_time_ewma * (len(self._pending) + 1),
@@ -163,6 +170,9 @@ class RequestQueue:
                 )
             if self.on_expire is not None:
                 self.on_expire(len(dead))
+            telemetry_events.annotate(
+                "serving.queue.expire", count=len(dead)
+            )
         return dead
 
     def expire_overdue(self) -> int:

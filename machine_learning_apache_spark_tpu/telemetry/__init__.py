@@ -1,0 +1,97 @@
+"""telemetry/ — unified observability for the whole stack.
+
+One subsystem every layer reports into, replacing four disconnected
+islands (``utils.timing``, ``utils.profiling``, ``train.metrics``,
+``serving.metrics``) with a single event substrate:
+
+- :mod:`~.events` — lock-protected, bounded in-process event log
+  (span start/stop, counter, gauge, annotation) with JSONL export;
+- :mod:`~.spans` — nested trace spans (context manager + decorator),
+  thread-local stacks, plus the re-homed ``Timer``/``timed_span``;
+- :mod:`~.registry` — process-global counters/gauges/histograms with
+  ``snapshot()`` and Prometheus text export;
+- :mod:`~.aggregate` — merge per-rank ``telemetry_rank<k>.jsonl`` files
+  into per-phase p50/p99 tables and a rank-skew (straggler) report;
+- :mod:`~.recorder` — flight recorder: dump the last ~512 events to
+  ``flight_<rank>.json`` at the moment of failure.
+
+Configuration is environmental: ``MLSPARK_TELEMETRY=0`` turns every
+entry point into a no-op singleton (zero per-step allocation);
+``MLSPARK_TELEMETRY_DIR`` is where rank exports and flight dumps land.
+All submodules are stdlib-only — safe to import before JAX platform
+configuration (the launcher's runner does exactly that).
+
+See docs/OBSERVABILITY.md for the event schema and workflows.
+"""
+
+from machine_learning_apache_spark_tpu.telemetry.aggregate import (
+    merge_gang_dir,
+    render_markdown,
+    write_rank_file,
+)
+from machine_learning_apache_spark_tpu.telemetry import events as _events_mod
+from machine_learning_apache_spark_tpu.telemetry import (
+    registry as _registry_mod,
+)
+from machine_learning_apache_spark_tpu.telemetry.events import (
+    ENV_TELEMETRY,
+    ENV_TELEMETRY_DIR,
+    Event,
+    EventLog,
+    annotate,
+    enabled,
+    get_log,
+    set_enabled,
+    telemetry_dir,
+)
+from machine_learning_apache_spark_tpu.telemetry.recorder import (
+    FLIGHT_CAPACITY,
+    dump_flight,
+    flight_path,
+    load_flight,
+)
+from machine_learning_apache_spark_tpu.telemetry.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+from machine_learning_apache_spark_tpu.telemetry.spans import (
+    Timer,
+    current_span_id,
+    span,
+    timed_span,
+    traced,
+)
+
+
+def reset() -> None:
+    """Drop ALL process-global telemetry state (event log, registry,
+    cached enabled flag) — test hook and fork/spawn re-arm."""
+    _events_mod.reset()
+    _registry_mod.reset()
+
+__all__ = [
+    "ENV_TELEMETRY",
+    "ENV_TELEMETRY_DIR",
+    "Event",
+    "EventLog",
+    "FLIGHT_CAPACITY",
+    "MetricsRegistry",
+    "Timer",
+    "annotate",
+    "current_span_id",
+    "dump_flight",
+    "enabled",
+    "flight_path",
+    "get_log",
+    "get_registry",
+    "load_flight",
+    "merge_gang_dir",
+    "render_markdown",
+    "reset",
+    "set_enabled",
+    "span",
+    "telemetry_dir",
+    "timed_span",
+    "traced",
+    "write_rank_file",
+]

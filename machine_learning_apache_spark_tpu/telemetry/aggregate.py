@@ -1,0 +1,227 @@
+"""Gang-wide telemetry aggregation: merge per-rank JSONL into one report.
+
+Each rank exports its event log as ``telemetry_rank<k>.jsonl`` (the
+launcher's runner does this in its exit path, next to the heartbeat
+files). This module merges those files into:
+
+- a **per-phase table** — for every span name, per-rank and overall
+  count / mean / p50 / p99 durations;
+- a **skew report** — for every phase seen on >1 rank, which rank is
+  slowest (by mean duration), the slowest/fastest ratio, and the spread.
+  In an SPMD gang every rank runs the same program, so a phase whose
+  mean differs across ranks is a straggler signature — this is the
+  slowest-rank attribution the comms-optimization PRs need.
+
+Percentiles are nearest-rank via the same ``percentile`` definition the
+registry and serving ledger use. Consumed by rank 0 in-process or by
+``tools/telemetry_report.py`` offline; pure functions over plain dicts,
+stdlib-only.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+from machine_learning_apache_spark_tpu.telemetry.registry import _percentile
+
+RANK_FILE_RE = re.compile(r"telemetry_rank(\d+)\.jsonl$")
+
+
+def rank_file_name(rank: int) -> str:
+    return f"telemetry_rank{rank}.jsonl"
+
+
+def write_rank_file(directory: str, rank: int | None = None) -> str:
+    """Export this process's event log as ``telemetry_rank<k>.jsonl`` in
+    ``directory``; returns the path. Rank defaults to the env rank (0 when
+    running outside a gang)."""
+    from machine_learning_apache_spark_tpu.telemetry import events as _events
+
+    if rank is None:
+        r = _events._env_rank()
+        rank = 0 if r is None else r
+    path = os.path.join(directory, rank_file_name(rank))
+    _events.get_log().export_jsonl(path)
+    return path
+
+
+def load_jsonl(path: str) -> list[dict]:
+    """Read one rank's JSONL export. Tolerates a trailing partial line
+    (a killed writer) but raises on malformed interior lines."""
+    out: list[dict] = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break  # torn final line from a killed process
+            raise
+    return out
+
+
+def find_rank_files(directory: str) -> dict[int, str]:
+    """``{rank: path}`` for every ``telemetry_rank<k>.jsonl`` in a dir."""
+    out: dict[int, str] = {}
+    for path in glob.glob(os.path.join(directory, "telemetry_rank*.jsonl")):
+        m = RANK_FILE_RE.search(os.path.basename(path))
+        if m:
+            out[int(m.group(1))] = path
+    return dict(sorted(out.items()))
+
+
+def merge_rank_files(paths: dict[int, str]) -> list[dict]:
+    """Concatenate rank exports into one event list, stamping each event's
+    ``rank`` with the rank from the FILE NAME (authoritative — an event
+    recorded before the env contract was set carries rank=None)."""
+    merged: list[dict] = []
+    for rank, path in sorted(paths.items()):
+        for ev in load_jsonl(path):
+            ev = dict(ev)
+            ev["rank"] = rank
+            merged.append(ev)
+    return merged
+
+
+def _stats(durations: list[float]) -> dict:
+    return {
+        "count": len(durations),
+        "mean": round(sum(durations) / len(durations), 6),
+        "p50": _percentile(durations, 50),
+        "p99": _percentile(durations, 99),
+        "max": max(durations),
+    }
+
+
+def phase_table(events: list[dict]) -> dict:
+    """Per-span-name duration stats: ``{phase: {"overall": stats,
+    "ranks": {rank: stats}}}``, built from ``span_end`` events."""
+    by_phase: dict[str, dict[int | None, list[float]]] = {}
+    for ev in events:
+        if ev.get("kind") != "span_end" or ev.get("value") is None:
+            continue
+        by_phase.setdefault(ev["name"], {}).setdefault(
+            ev.get("rank"), []
+        ).append(float(ev["value"]))
+    table: dict[str, dict] = {}
+    for phase in sorted(by_phase):
+        per_rank = by_phase[phase]
+        all_durs = [d for durs in per_rank.values() for d in durs]
+        table[phase] = {
+            "overall": _stats(all_durs),
+            "ranks": {
+                rank: _stats(durs)
+                for rank, durs in sorted(
+                    per_rank.items(), key=lambda kv: (kv[0] is None, kv[0])
+                )
+            },
+        }
+    return table
+
+
+def skew_report(table: dict) -> dict:
+    """Straggler attribution from a ``phase_table``: for every phase with
+    >1 rank, the slowest rank by mean duration and the slow/fast ratio."""
+    report: dict[str, dict] = {}
+    for phase, entry in table.items():
+        ranks = {
+            r: s for r, s in entry["ranks"].items() if r is not None
+        }
+        if len(ranks) < 2:
+            continue
+        slowest = max(ranks, key=lambda r: ranks[r]["mean"])
+        fastest = min(ranks, key=lambda r: ranks[r]["mean"])
+        fast_mean = ranks[fastest]["mean"]
+        slow_mean = ranks[slowest]["mean"]
+        report[phase] = {
+            "slowest_rank": slowest,
+            "fastest_rank": fastest,
+            "slowest_mean": slow_mean,
+            "fastest_mean": fast_mean,
+            "skew_ratio": round(slow_mean / fast_mean, 4)
+            if fast_mean > 0 else None,
+            "spread": round(slow_mean - fast_mean, 6),
+        }
+    return report
+
+
+def merge_gang_dir(directory: str) -> dict:
+    """One-call report over a gang workdir: find rank files, merge, build
+    the phase table and skew report."""
+    paths = find_rank_files(directory)
+    events = merge_rank_files(paths)
+    table = phase_table(events)
+    return {
+        "artifact": "telemetry_report",
+        "directory": os.path.abspath(directory),
+        "ranks": sorted(paths),
+        "event_count": len(events),
+        "phases": table,
+        "skew": skew_report(table),
+    }
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v * 1e3:.3f}" if v < 10 else f"{v:.3f}"
+    return str(v)
+
+
+def render_markdown(report: dict) -> str:
+    """Human-readable form of ``merge_gang_dir``'s output: a per-phase
+    p50/p99 table (durations in ms) and the rank-skew table."""
+    lines = ["# Telemetry report", ""]
+    lines.append(f"- ranks: {report['ranks']}")
+    lines.append(f"- events merged: {report['event_count']}")
+    lines += ["", "## Per-phase durations (ms)", ""]
+    lines.append("| phase | rank | count | mean | p50 | p99 | max |")
+    lines.append("|---|---|---|---|---|---|---|")
+    for phase, entry in report["phases"].items():
+        o = entry["overall"]
+        lines.append(
+            f"| {phase} | all | {o['count']} | {_fmt(o['mean'])} "
+            f"| {_fmt(o['p50'])} | {_fmt(o['p99'])} | {_fmt(o['max'])} |"
+        )
+        for rank, s in entry["ranks"].items():
+            lines.append(
+                f"| {phase} | {rank} | {s['count']} | {_fmt(s['mean'])} "
+                f"| {_fmt(s['p50'])} | {_fmt(s['p99'])} | {_fmt(s['max'])} |"
+            )
+    skew = report.get("skew") or {}
+    lines += ["", "## Rank skew (straggler attribution)", ""]
+    if skew:
+        lines.append(
+            "| phase | slowest rank | fastest rank | skew ratio | spread (ms) |"
+        )
+        lines.append("|---|---|---|---|---|")
+        for phase, s in skew.items():
+            ratio = s["skew_ratio"]
+            lines.append(
+                f"| {phase} | {s['slowest_rank']} | {s['fastest_rank']} "
+                f"| {ratio if ratio is not None else '-'} "
+                f"| {_fmt(s['spread'])} |"
+            )
+    else:
+        lines.append("(no phase seen on more than one rank)")
+    return "\n".join(lines) + "\n"
+
+
+__all__ = [
+    "find_rank_files",
+    "load_jsonl",
+    "merge_gang_dir",
+    "merge_rank_files",
+    "phase_table",
+    "rank_file_name",
+    "render_markdown",
+    "skew_report",
+    "write_rank_file",
+]

@@ -21,6 +21,7 @@ from typing import Any, Callable, Iterable
 import jax
 import jax.numpy as jnp
 
+from machine_learning_apache_spark_tpu import telemetry
 from machine_learning_apache_spark_tpu.parallel.mesh import shard_batch
 from machine_learning_apache_spark_tpu.train.metrics import MetricBundle, logits_accuracy
 from machine_learning_apache_spark_tpu.train.state import TrainState
@@ -251,15 +252,29 @@ def fit(
     )
     total_timer = Timer("train").start()
     span_timer = Timer("span").start()
+    fit_span = telemetry.span(
+        "train.fit", epochs=epochs, steps_per_call=steps_per_call,
+        resumed_step=resumed_step,
+    )
     try:
         try:
-            state, history = _run_epochs(
-                state, step_fn, train_loader, epochs, rng, mesh, log_every,
-                emit, tracer, checkpointer, checkpoint_every, span_timer, sink,
-                sync_check_every, multi_fn, steps_per_call,
-                prefetch_to_device, start_epoch,
-                int(resumed_step) if resumed_step is not None else 0,
+            with fit_span:
+                state, history = _run_epochs(
+                    state, step_fn, train_loader, epochs, rng, mesh,
+                    log_every, emit, tracer, checkpointer, checkpoint_every,
+                    span_timer, sink, sync_check_every, multi_fn,
+                    steps_per_call, prefetch_to_device, start_epoch,
+                    int(resumed_step) if resumed_step is not None else 0,
+                )
+        except BaseException as e:
+            # Flight recorder: an unhandled exception out of the training
+            # loop ships with its last events (the failing step's spans are
+            # the newest entries). Errored span_end for train.fit was just
+            # emitted by the with-block, so it is included.
+            telemetry.dump_flight(
+                f"train.fit:{type(e).__name__}", extra={"error": str(e)[:500]}
             )
+            raise
         finally:
             # An exception mid-window must still stop the (process-global)
             # jax profiler, or every later trace in this process fails to
@@ -323,6 +338,12 @@ def _run_epochs(
     global_step = start_step
     last_emit_step = global_step
     for epoch in range(start_epoch, epochs):
+        # Manual enter/exit (not a with-block) keeps the 130-line epoch body
+        # at its indent. On an exception the span_end is skipped — the step
+        # span and fit span still close errored, and _Span.__exit__ pops
+        # leaked ids, so parent attribution stays correct.
+        epoch_span = telemetry.span("train.epoch", epoch=epoch)
+        epoch_span.__enter__()
         if hasattr(train_loader, "set_epoch"):
             train_loader.set_epoch(epoch)
         epoch_metrics = MetricBundle()
@@ -372,13 +393,17 @@ def _run_epochs(
             )
             tracer.on_step(global_step)
             prev = global_step
-            # The scanned dispatch covers steps [prev, prev+K): check every
-            # coordinate in the span so a step-pinned fault fires regardless
-            # of steps_per_call (at group granularity — the whole group is
-            # lost, which is within the <=1-checkpoint-interval guarantee).
-            for s in range(prev, prev + len(group)):
-                maybe_fault("train_step", step=s)
-            state, rng, losses, auxes = multi_fn(state, stacked, rng)
+            with telemetry.span(
+                "train.step_group", start=prev, count=len(group)
+            ):
+                # The scanned dispatch covers steps [prev, prev+K): check
+                # every coordinate in the span so a step-pinned fault fires
+                # regardless of steps_per_call (at group granularity — the
+                # whole group is lost, which is within the
+                # <=1-checkpoint-interval guarantee).
+                for s in range(prev, prev + len(group)):
+                    maybe_fault("train_step", step=s)
+                state, rng, losses, auxes = multi_fn(state, stacked, rng)
             global_step += len(group)
             pending.append((
                 losses.mean(),
@@ -395,8 +420,9 @@ def _run_epochs(
                 batch = shard_batch(mesh, batch)
             rng, step_rng = jax.random.split(rng)
             tracer.on_step(global_step)
-            maybe_fault("train_step", step=global_step)
-            state, loss, aux = step_fn(state, batch, step_rng)
+            with telemetry.span("train.step", step=global_step):
+                maybe_fault("train_step", step=global_step)
+                state, loss, aux = step_fn(state, batch, step_rng)
             global_step += 1
             pending.append((loss, aux, 1))
             if _log_point(global_step - 1):
@@ -459,6 +485,7 @@ def _run_epochs(
                     },
                 },
             )
+        epoch_span.__exit__(None, None, None)
     return state, history
 
 

@@ -232,6 +232,22 @@ def maybe_fault(site: str, *, step: int | None = None,
         return
     _log().warning("fault injection firing: %s (site=%s step=%s batch=%s)",
                 spec.key, site, step, batch)
+    # Flight recorder BEFORE the action: an os._exit'd (or stalled) process
+    # gets no later chance, so the dump must happen while we still run. The
+    # failing step's span_start is already in the event log (instrumented
+    # call sites open their span before maybe_fault). Lazy import + broad
+    # swallow: this module must stay stdlib-importable and a recorder
+    # problem must never mask the drill itself.
+    try:
+        from machine_learning_apache_spark_tpu.telemetry import recorder
+
+        recorder.dump_flight(
+            f"fault:{spec.key}",
+            extra={"site": site, "step": step, "batch": batch,
+                   "action": spec.action},
+        )
+    except Exception:
+        pass
     if spec.action == "raise":
         raise FaultInjected(f"injected fault {spec.key}")
     if spec.action == "crash":

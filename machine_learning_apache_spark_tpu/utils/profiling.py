@@ -24,6 +24,7 @@ import contextlib
 
 import jax
 
+from machine_learning_apache_spark_tpu.telemetry import spans as _spans
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -54,9 +55,33 @@ def device_trace(log_dir: str):
         log.info("profiler trace written to %s", log_dir)
 
 
+class _AnnotatedRegion:
+    """Context manager pairing a jax.profiler.TraceAnnotation (device
+    timeline) with a telemetry span (host event log): one entry point, the
+    region shows up in both worlds. The telemetry half is the shared no-op
+    when disabled, so the hot serving decode path pays only the
+    TraceAnnotation it already paid."""
+
+    __slots__ = ("_trace", "_span")
+
+    def __init__(self, name: str, **kwargs):
+        self._trace = jax.profiler.TraceAnnotation(name, **kwargs)
+        self._span = _spans.span(name, **kwargs)
+
+    def __enter__(self):
+        self._span.__enter__()
+        self._trace.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._trace.__exit__(*exc)
+        self._span.__exit__(*exc)
+
+
 def annotate(name: str, **kwargs):
-    """Named region annotation appearing on the trace timeline."""
-    return jax.profiler.TraceAnnotation(name, **kwargs)
+    """Named region annotation appearing on the trace timeline (and, when
+    telemetry is enabled, as a span on the event log)."""
+    return _AnnotatedRegion(name, **kwargs)
 
 
 def step_annotation(step: int):

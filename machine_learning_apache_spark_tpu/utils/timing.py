@@ -1,50 +1,19 @@
-"""Wall-clock timing spans.
+"""Wall-clock timing spans — re-homed onto :mod:`..telemetry.spans`.
 
 The reference self-times every training run with ``time.time()`` pairs (19
 sites; e.g. ``pytorch_multilayer_perceptron.py:98,118-120``) plus a rolling
-per-100-batch span (``pytorch_machine_translator.py:150,199-205``). This
-module is the one structured implementation of that vocabulary.
+per-100-batch span (``pytorch_machine_translator.py:150,199-205``).
+``Timer`` and ``timed_span`` are the structured implementation of that
+vocabulary; they now live in the telemetry subsystem so ad-hoc timings and
+structured trace spans share one event log. This module remains as the
+back-compat import surface — existing call sites keep working unchanged.
 """
 
 from __future__ import annotations
 
-import contextlib
-import time
-from dataclasses import dataclass, field
+from machine_learning_apache_spark_tpu.telemetry.spans import (  # noqa: F401
+    Timer,
+    timed_span,
+)
 
-
-@dataclass
-class Timer:
-    """Start/stop wall-clock timer with rolling-span support."""
-
-    name: str = "train"
-    _start: float = field(default_factory=time.perf_counter, repr=False)
-    elapsed: float = 0.0
-
-    def start(self) -> "Timer":
-        self._start = time.perf_counter()
-        return self
-
-    def stop(self) -> float:
-        self.elapsed = time.perf_counter() - self._start
-        return self.elapsed
-
-    def lap(self) -> float:
-        """Elapsed since last start/lap; restarts the span (the reference's
-        rolling 100-batch timer, ``pytorch_machine_translator.py:199-205``)."""
-        now = time.perf_counter()
-        span = now - self._start
-        self._start = now
-        return span
-
-
-@contextlib.contextmanager
-def timed_span(label: str, emit=None):
-    """``with timed_span("Training Time"):`` — prints ``<label>: <sec>`` on
-    exit, the reference's universal metric line (SURVEY.md §6)."""
-    t = Timer(label).start()
-    try:
-        yield t
-    finally:
-        t.stop()
-        (emit or print)(f"{label}: {t.elapsed:.3f} sec")
+__all__ = ["Timer", "timed_span"]

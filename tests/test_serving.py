@@ -231,6 +231,25 @@ class TestMetrics:
         assert s["batch_occupancy"]["p50"] == 0.5
         assert m.log_summary()["completed"] == 1
 
+    def test_conservation_check(self):
+        from machine_learning_apache_spark_tpu.serving import ServingMetrics
+        from machine_learning_apache_spark_tpu.serving.metrics import (
+            ConservationError,
+        )
+
+        m = ServingMetrics()
+        for _ in range(4):
+            m.on_submit()
+        m.on_complete(queue_wait=0.1, ttft=0.2, total=0.3)
+        m.on_reject()
+        m.on_expire()
+        # 4 submitted = 1 completed + 1 rejected + 1 expired + 1 in flight
+        ledger = m.check_conservation(in_flight=1)
+        assert ledger["submitted"] == 4 and ledger["in_flight"] == 1
+        # ... but claiming zero in flight leaks one request: must raise
+        with pytest.raises(ConservationError, match="conservation violated"):
+            m.check_conservation(in_flight=0)
+
 
 def test_jit_cache_size_counts_programs():
     """The compile counter behind ``recompiles_after_warmup``: one entry
@@ -303,6 +322,7 @@ class TestEngineE2E:
             assert eng.recompiles_after_warmup == 0
             assert eng.metrics.completed == 32
             assert eng.pool.in_use == 0  # every slot freed on EOS
+            eng.metrics.check_conservation(in_flight=0)
         assert outs == t(texts, max_new_tokens=8)
 
     def test_queue_rejects_when_saturated(self, tiny_translator):
@@ -324,6 +344,9 @@ class TestEngineE2E:
             assert eng.metrics.rejected == hits
         finally:
             eng.stop()
+        # every attempt accounted: rejected at the door, completed before
+        # stop, or failed by it — nothing vanishes
+        eng.metrics.check_conservation(in_flight=0)
 
     def test_deadline_expiry_frees_slots_and_fails_future(
         self, tiny_translator
@@ -372,6 +395,8 @@ class TestEngineE2E:
         for r in reqs:
             with pytest.raises(EngineStopped):
                 r.result(timeout=5)
+        ledger = eng.metrics.check_conservation(in_flight=0)
+        assert ledger["submitted"] == 3 and ledger["failed"] == 3
 
     def test_beam_method_serves(self, tiny_translator):
         t, texts = tiny_translator

@@ -1,0 +1,436 @@
+"""telemetry/: event log, trace spans, metrics registry, gang aggregation,
+and the crash flight recorder (docs/OBSERVABILITY.md).
+
+Unit tests drive each surface directly; the aggregation tests build a
+synthetic 2-rank gang from hand-written JSONL (deterministic durations,
+so the skew report's straggler attribution is exact) and the CLI test
+runs ``tools/telemetry_report.py`` against that fixture end to end.
+Disabled-mode tests pin the zero-cost contract: module-level no-op
+singletons, nothing written, nothing stored.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from machine_learning_apache_spark_tpu import telemetry
+from machine_learning_apache_spark_tpu.telemetry import (
+    aggregate,
+    events,
+    recorder,
+    registry,
+    spans,
+)
+
+pytestmark = pytest.mark.telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry(monkeypatch):
+    """Every test gets a clean process-global log/registry and no env
+    overrides; state is re-armed afterwards so other suites see their own
+    environment, not this test's."""
+    monkeypatch.delenv(events.ENV_TELEMETRY, raising=False)
+    monkeypatch.delenv(events.ENV_TELEMETRY_DIR, raising=False)
+    monkeypatch.delenv(events.ENV_MAX_EVENTS, raising=False)
+    monkeypatch.delenv("MLSPARK_PROCESS_ID", raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# -- spans ---------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_parent_attribution_and_timestamps(self):
+        with telemetry.span("outer") as outer:
+            assert spans.current_span_id() == outer.id
+            with telemetry.span("inner", step=3) as inner:
+                assert spans.current_span_id() == inner.id
+            assert spans.current_span_id() == outer.id
+        assert spans.current_span_id() is None
+
+        evs = events.get_log().snapshot()
+        assert [(e.kind, e.name) for e in evs] == [
+            ("span_start", "outer"),
+            ("span_start", "inner"),
+            ("span_end", "inner"),
+            ("span_end", "outer"),
+        ]
+        start_inner, end_inner, end_outer = evs[1], evs[2], evs[3]
+        assert start_inner.span == inner.id
+        assert start_inner.parent == outer.id
+        assert start_inner.attrs == {"step": 3}
+        assert end_inner.value is not None and end_inner.value >= 0
+        assert end_outer.value >= end_inner.value  # outer encloses inner
+        ts = [e.ts for e in evs]
+        assert ts == sorted(ts)  # monotonic within a process
+        assert all(e.wall > 0 and e.pid == os.getpid() for e in evs)
+
+    def test_exception_tagged_on_span_end(self):
+        with pytest.raises(RuntimeError):
+            with telemetry.span("boom"):
+                raise RuntimeError("x")
+        end = events.get_log().snapshot()[-1]
+        assert end.kind == "span_end" and end.name == "boom"
+        assert end.attrs["error"] == "RuntimeError"
+        assert spans.current_span_id() is None  # stack unwound
+
+    def test_leaked_inner_span_does_not_corrupt_stack(self):
+        outer = telemetry.span("outer")
+        outer.__enter__()
+        spans._Span("leaked", None).__enter__()  # never exited
+        outer.__exit__(None, None, None)
+        assert spans.current_span_id() is None
+
+    def test_traced_decorator(self):
+        @spans.traced("my.fn")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+        names = [e.name for e in events.get_log().snapshot()]
+        assert names == ["my.fn", "my.fn"]
+
+    def test_per_thread_stacks(self):
+        import threading
+
+        got = {}
+
+        def other():
+            got["id"] = spans.current_span_id()
+
+        with telemetry.span("main-only"):
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert got["id"] is None  # spans never leak across threads
+
+
+# -- event log -----------------------------------------------------------------
+
+
+class TestEventLog:
+    def test_ring_eviction_counts_drops(self):
+        log = events.EventLog(max_events=4)
+        for i in range(6):
+            log.emit("annotation", f"a{i}")
+        assert len(log) == 4 and log.dropped == 2
+        assert [e.name for e in log.snapshot()] == ["a2", "a3", "a4", "a5"]
+        assert [e.name for e in log.tail(2)] == ["a4", "a5"]
+        log.clear()
+        assert len(log) == 0 and log.dropped == 0
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown event kind"):
+            events.EventLog().emit("bogus", "x")
+
+    def test_jsonl_round_trip_and_torn_tail(self, tmp_path):
+        log = events.EventLog()
+        log.emit("annotation", "a", attrs={"k": 1})
+        log.emit("counter", "c", value=2.0)
+        path = str(tmp_path / "out.jsonl")
+        assert log.export_jsonl(path) == 2
+        back = aggregate.load_jsonl(path)
+        assert [d["name"] for d in back] == ["a", "c"]
+        assert back[0]["attrs"] == {"k": 1} and back[1]["value"] == 2.0
+        # a killed writer's torn final line is skipped, not fatal
+        with open(path, "a") as f:
+            f.write('{"kind": "annotation", "na')
+        assert len(aggregate.load_jsonl(path)) == 2
+        # ... but a malformed interior line is corruption and raises
+        with open(path, "a") as f:
+            f.write("\n{}\n")
+        with pytest.raises(json.JSONDecodeError):
+            aggregate.load_jsonl(path)
+
+    def test_max_events_env_knob(self, monkeypatch):
+        monkeypatch.setenv(events.ENV_MAX_EVENTS, "7")
+        telemetry.reset()
+        assert events.get_log().max_events == 7
+
+
+# -- registry ------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_snapshot(self):
+        reg = registry.get_registry()
+        reg.counter("train", "steps").inc(3)
+        reg.gauge("serving", "queue_depth").set(5)
+        h = reg.histogram("train", "step_s")
+        for v in (0.1, 0.2, 0.3, 0.4):
+            h.observe(v)
+        snap = reg.snapshot()
+        assert snap["train"]["steps"] == 3
+        assert snap["serving"]["queue_depth"] == 5
+        assert snap["train"]["step_s"]["count"] == 4
+        assert snap["train"]["step_s"]["p50"] == 0.2
+        # same (scope, name) returns the same metric object
+        assert reg.counter("train", "steps") is reg.counter("train", "steps")
+
+    def test_counter_rejects_decrease_and_type_conflicts(self):
+        reg = registry.get_registry()
+        with pytest.raises(ValueError):
+            reg.counter("t", "x").inc(-1)
+        with pytest.raises(TypeError, match="already registered"):
+            reg.gauge("t", "x")
+
+    def test_histogram_ring_keeps_cumulative_count(self):
+        h = registry.HistogramMetric("t", "x", max_samples=4)
+        for v in range(1, 11):
+            h.observe(float(v))
+        s = h.summary()
+        assert s["count"] == 10 and s["sum"] == 55.0  # cumulative past evict
+        assert s["max"] == 10.0  # newest sample survives the ring
+        assert h.percentile(0) >= 7.0  # oldest samples (1..6) evicted
+
+    def test_prometheus_text_and_rank_label(self, monkeypatch):
+        reg = registry.get_registry()
+        reg.counter("serving", "submitted").inc(12)
+        h = reg.histogram("train", "step_s")
+        h.observe(0.5)
+        text = reg.to_prometheus_text()
+        assert "# TYPE mlspark_serving_submitted counter" in text
+        assert "mlspark_serving_submitted 12" in text
+        assert 'mlspark_train_step_s{quantile="0.5"} 0.5' in text
+        assert "mlspark_train_step_s_count 1" in text
+        monkeypatch.setenv("MLSPARK_PROCESS_ID", "1")
+        assert 'mlspark_serving_submitted{rank="1"} 12' in (
+            reg.to_prometheus_text()
+        )
+
+    def test_name_sanitization(self):
+        reg = registry.get_registry()
+        reg.counter("serving", "p99.latency-ms").inc()
+        assert "mlspark_serving_p99_latency_ms 1" in reg.to_prometheus_text()
+
+
+# -- flight recorder -----------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_dump_and_load(self, tmp_path):
+        with telemetry.span("step"):
+            telemetry.annotate("checkpoint", step=7)
+        path = recorder.dump_flight(
+            "test:crash", directory=str(tmp_path), extra={"step": 7}
+        )
+        assert path == str(tmp_path / "flight_driver.json")
+        dump = recorder.load_flight(path)
+        assert dump["artifact"] == "flight"
+        assert dump["reason"] == "test:crash"
+        assert dump["rank"] is None and dump["extra"] == {"step": 7}
+        assert dump["event_count"] == len(dump["events"]) == 3
+        assert [e["name"] for e in dump["events"]] == [
+            "step", "checkpoint", "step",
+        ]
+
+    def test_capacity_bounds_the_tail(self, tmp_path):
+        for i in range(recorder.FLIGHT_CAPACITY + 50):
+            telemetry.annotate(f"a{i}")
+        path = recorder.dump_flight("test", directory=str(tmp_path))
+        dump = recorder.load_flight(path)
+        assert dump["event_count"] == recorder.FLIGHT_CAPACITY
+        assert dump["events"][-1]["name"] == f"a{recorder.FLIGHT_CAPACITY + 49}"
+
+    def test_rank_in_file_name(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MLSPARK_PROCESS_ID", "3")
+        telemetry.annotate("x")
+        path = recorder.dump_flight("test", directory=str(tmp_path))
+        assert path.endswith("flight_3.json")
+        assert recorder.load_flight(path)["rank"] == 3
+
+    def test_no_directory_means_no_dump(self):
+        telemetry.annotate("x")
+        assert recorder.dump_flight("test") is None  # never raises
+
+
+# -- gang aggregation ----------------------------------------------------------
+
+
+def _write_rank_jsonl(directory, rank, phases):
+    """Hand-built rank export: ``phases`` is {name: [durations]}. Events
+    carry rank=None on purpose — the merge must stamp rank from the file
+    name, which is authoritative."""
+    path = os.path.join(directory, aggregate.rank_file_name(rank))
+    sid = 0
+    t = 0.0
+    with open(path, "w") as f:
+        for name, durations in phases.items():
+            for d in durations:
+                sid += 1
+                f.write(json.dumps({
+                    "kind": "span_start", "name": name, "ts": t,
+                    "wall": 1e9 + t, "rank": None, "pid": 1, "span": sid,
+                }) + "\n")
+                t += d
+                f.write(json.dumps({
+                    "kind": "span_end", "name": name, "ts": t,
+                    "wall": 1e9 + t, "rank": None, "pid": 1, "span": sid,
+                    "value": d,
+                }) + "\n")
+    return path
+
+
+@pytest.fixture
+def two_rank_dir(tmp_path):
+    """A synthetic 2-rank gang: rank 1 is a 3x straggler on train.step and
+    also the only rank running io.load."""
+    d = str(tmp_path / "gang")
+    os.makedirs(d)
+    _write_rank_jsonl(d, 0, {"train.step": [0.010, 0.010, 0.010, 0.010]})
+    _write_rank_jsonl(d, 1, {
+        "train.step": [0.030, 0.030, 0.030, 0.030],
+        "io.load": [0.5],
+    })
+    return d
+
+
+class TestAggregation:
+    def test_merge_phase_table_and_skew(self, two_rank_dir):
+        report = aggregate.merge_gang_dir(two_rank_dir)
+        assert report["ranks"] == [0, 1]
+        assert report["event_count"] == 18  # (4 + 4 + 1) spans × 2 events
+
+        step = report["phases"]["train.step"]
+        assert step["overall"]["count"] == 8
+        assert step["ranks"][0]["p50"] == 0.010
+        assert step["ranks"][1]["p99"] == 0.030
+        assert report["phases"]["io.load"]["ranks"][1]["count"] == 1
+
+        skew = report["skew"]
+        assert "io.load" not in skew  # single-rank phase: no skew entry
+        s = skew["train.step"]
+        assert s["slowest_rank"] == 1 and s["fastest_rank"] == 0
+        assert s["skew_ratio"] == 3.0
+        assert abs(s["spread"] - 0.020) < 1e-9
+
+    def test_render_markdown(self, two_rank_dir):
+        md = aggregate.render_markdown(aggregate.merge_gang_dir(two_rank_dir))
+        assert "# Telemetry report" in md
+        assert "| train.step | all | 8 |" in md
+        assert "## Rank skew" in md
+        assert "| train.step | 1 | 0 | 3.0 |" in md
+
+    def test_write_rank_file_exports_live_log(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MLSPARK_PROCESS_ID", "2")
+        with telemetry.span("train.step"):
+            pass
+        path = aggregate.write_rank_file(str(tmp_path))
+        assert path.endswith("telemetry_rank2.jsonl")
+        assert aggregate.find_rank_files(str(tmp_path)) == {2: path}
+        merged = aggregate.merge_rank_files({2: path})
+        assert [e["rank"] for e in merged] == [2, 2]
+
+
+class TestReportCLI:
+    """tools/telemetry_report.py against the synthetic 2-rank fixture."""
+
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "telemetry_report.py"), *argv],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+    def test_merges_directory_into_json_and_md(self, two_rank_dir, tmp_path):
+        json_out = str(tmp_path / "report.json")
+        md_out = str(tmp_path / "report.md")
+        proc = self._run(two_rank_dir, "--json", json_out, "--md", md_out)
+        assert proc.returncode == 0, proc.stderr
+        with open(json_out) as f:
+            report = json.load(f)
+        assert report["artifact"] == "telemetry_report"
+        assert report["ranks"] == [0, 1]
+        assert report["skew"]["train.step"]["slowest_rank"] == 1
+        with open(md_out) as f:
+            assert "## Per-phase durations (ms)" in f.read()
+        assert "merged 18 events from ranks [0, 1]" in proc.stdout
+
+    def test_markdown_to_stdout_by_default(self, two_rank_dir):
+        proc = self._run(two_rank_dir)
+        assert proc.returncode == 0, proc.stderr
+        assert "# Telemetry report" in proc.stdout
+
+    def test_empty_directory_is_an_error(self, tmp_path):
+        proc = self._run(str(tmp_path))
+        assert proc.returncode == 1
+        assert "no telemetry_rank" in proc.stderr
+
+
+# -- disabled mode -------------------------------------------------------------
+
+
+class TestDisabledMode:
+    def test_env_kill_switch_spellings(self, monkeypatch):
+        for v in ("0", "false", "off", "no", " OFF "):
+            monkeypatch.setenv(events.ENV_TELEMETRY, v)
+            telemetry.reset()
+            assert not events.enabled(), v
+        monkeypatch.setenv(events.ENV_TELEMETRY, "1")
+        telemetry.reset()
+        assert events.enabled()
+
+    def test_noop_singletons_and_nothing_recorded(self, tmp_path):
+        events.set_enabled(False)
+        # identity, not equality: the no-op path allocates nothing per call
+        assert telemetry.span("x") is spans.NOOP_SPAN
+        assert telemetry.span("y", a=1) is spans.NOOP_SPAN
+        assert events.get_log() is events.NOOP_LOG
+        assert registry.get_registry() is registry.NOOP_REGISTRY
+
+        with telemetry.span("x"):
+            telemetry.annotate("a")
+        registry.get_registry().counter("t", "c").inc()
+        assert len(events.get_log()) == 0
+        assert registry.get_registry().snapshot() == {}
+        assert registry.get_registry().to_prometheus_text() == ""
+        assert recorder.dump_flight("test", directory=str(tmp_path)) is None
+        assert os.listdir(str(tmp_path)) == []
+        assert events.get_log().export_jsonl(str(tmp_path / "x.jsonl")) == 0
+
+    def test_timed_span_still_prints_when_disabled(self):
+        events.set_enabled(False)
+        lines = []
+        with spans.timed_span("Training Time", emit=lines.append):
+            pass
+        assert len(lines) == 1 and lines[0].startswith("Training Time: ")
+        assert len(events.get_log()) == 0
+
+
+# -- back-compat re-exports ----------------------------------------------------
+
+
+class TestBackCompat:
+    def test_utils_timing_reexports(self):
+        from machine_learning_apache_spark_tpu.utils import timing
+
+        assert timing.Timer is spans.Timer
+        assert timing.timed_span is spans.timed_span
+
+    def test_timed_span_lands_on_the_timeline(self):
+        lines = []
+        with spans.timed_span("Epoch Time", emit=lines.append):
+            pass
+        assert lines and lines[0].startswith("Epoch Time: ")
+        names = [e.name for e in events.get_log().snapshot()]
+        assert names == ["Epoch Time", "Epoch Time"]  # span_start + span_end
+
+    def test_profiling_annotate_emits_spans(self):
+        from machine_learning_apache_spark_tpu.utils.profiling import annotate
+
+        with annotate("square", step=1):
+            pass
+        evs = events.get_log().snapshot()
+        assert [(e.kind, e.name) for e in evs] == [
+            ("span_start", "square"), ("span_end", "square"),
+        ]
+        assert evs[0].attrs == {"step": 1}

@@ -1,4 +1,4 @@
-"""Fault drill — run the injection scenarios end to end, emit FAULTS_r01.json.
+"""Fault drill — run the injection scenarios end to end, emit FAULTS_r02.json.
 
 The executable form of docs/FAULT_TOLERANCE.md: each scenario arms a
 deterministic fault plan (``utils.faults``), runs the real subsystem
@@ -15,9 +15,15 @@ against it, and records what the robustness layer did about it:
 - ``serving_poison`` — decode batch 0 raises; only its requests may
   fail (``InternalError``), the loop keeps serving, zero recompiles.
 
+Round 2 additionally asserts the flight recorder: every drilled failure
+must leave a non-empty ``flight_<rank>.json`` (dumped by ``maybe_fault``
+BEFORE the fault action executes — the failing step's span events ride
+along) in the scenario's ``MLSPARK_TELEMETRY_DIR``; the event counts are
+recorded in the artifact.
+
 Usage::
 
-    python tools/fault_drill.py [--out FAULTS_r01.json] [scenario ...]
+    python tools/fault_drill.py [--out FAULTS_r02.json] [scenario ...]
 
 Exits nonzero if any scenario's invariant does not hold, so CI can gate
 on the drill the way it gates on the test suite.
@@ -41,16 +47,44 @@ sys.path.insert(
 from machine_learning_apache_spark_tpu.utils import faults  # noqa: E402
 
 
-def _with_plan(plan: str, marker_dir: str):
+def _with_plan(plan: str, marker_dir: str, telemetry_dir: str | None = None):
     os.environ[faults.ENV_PLAN] = plan
     os.environ[faults.ENV_MARKER_DIR] = marker_dir
+    if telemetry_dir:
+        # Persistent flight-dump/rank-export destination: the gang workdir
+        # is rmtree'd by the Distributor, so the drill needs its own dir to
+        # assert flight files after the run. Workers inherit it (the
+        # Distributor's workdir default is a setdefault).
+        os.makedirs(telemetry_dir, exist_ok=True)
+        os.environ["MLSPARK_TELEMETRY_DIR"] = telemetry_dir
     faults.clear()  # re-arm the lazy env read in THIS process too
 
 
 def _clear_plan():
     os.environ.pop(faults.ENV_PLAN, None)
     os.environ.pop(faults.ENV_MARKER_DIR, None)
+    os.environ.pop("MLSPARK_TELEMETRY_DIR", None)
     faults.clear()
+
+
+def _flight_info(telemetry_dir: str, rank) -> dict:
+    """Summarize one ``flight_<rank>.json`` for the drill artifact: does it
+    exist, how many events, does it carry the failing site's spans?"""
+    path = os.path.join(telemetry_dir, f"flight_{rank}.json")
+    if not os.path.exists(path):
+        return {"path": path, "exists": False, "events": 0}
+    with open(path) as f:
+        dump = json.load(f)
+    events = dump.get("events", [])
+    return {
+        "path": path,
+        "exists": True,
+        "reason": dump.get("reason"),
+        "events": len(events),
+        "span_events": sum(
+            1 for e in events if e.get("kind") in ("span_start", "span_end")
+        ),
+    }
 
 
 def scenario_gang_crash_resume(workdir: str) -> dict:
@@ -63,7 +97,8 @@ def scenario_gang_crash_resume(workdir: str) -> dict:
 
     plan = "crash@train_step:rank=1,step=9"
     markers = os.path.join(workdir, "markers")
-    _with_plan(plan, markers)
+    tdir = os.path.join(workdir, "telemetry")
+    _with_plan(plan, markers, telemetry_dir=tdir)
     try:
         out = Distributor(
             num_processes=2, platform="cpu", timeout=300, max_restarts=1,
@@ -71,6 +106,9 @@ def scenario_gang_crash_resume(workdir: str) -> dict:
         ).run(
             "launcher_workers:fault_drill_train", os.path.join(workdir, "gang")
         )
+        # Flight recorder: rank 1 dumped its event-log tail in maybe_fault
+        # BEFORE os._exit — read it back while the env still points here.
+        flight = _flight_info(tdir, 1)
     finally:
         _clear_plan()
     fired = sorted(os.listdir(markers)) if os.path.isdir(markers) else []
@@ -83,8 +121,14 @@ def scenario_gang_crash_resume(workdir: str) -> dict:
         "drilled_final_loss": out["final_loss"],
         "loss_delta": loss_delta,
         "rank0_resumed_step": out["resumed_step"],
+        "flight": flight,
         "wall_seconds": round(time.monotonic() - t0, 2),
-        "ok": bool(fired) and loss_delta < 1e-6,
+        "ok": (
+            bool(fired)
+            and loss_delta < 1e-6
+            and flight["exists"]
+            and flight["events"] > 0
+        ),
     }
 
 
@@ -96,18 +140,29 @@ def scenario_gang_stall(workdir: str) -> dict:
 
     plan = "stall@train_step:rank=1,step=2"
     t0 = time.monotonic()
-    _with_plan(plan, os.path.join(workdir, "markers"))
+    tdir = os.path.join(workdir, "telemetry")
+    _with_plan(plan, os.path.join(workdir, "markers"), telemetry_dir=tdir)
     failure = None
     try:
+        # heartbeat_timeout must comfortably exceed worst-case python
+        # spawn-to-first-beat latency: a rank that has not beaten yet is
+        # judged against the same timeout from spawn time, and on a busy
+        # host (this drill runs right after the crash scenario's gangs) a
+        # 4s window can blame a slow-starting innocent rank 0.
         Distributor(
             num_processes=2, platform="cpu", timeout=300,
-            heartbeat_interval=0.2, heartbeat_timeout=4.0, term_grace=1.0,
+            heartbeat_interval=0.2, heartbeat_timeout=8.0, term_grace=1.0,
         ).run(
             "launcher_workers:fault_drill_train", os.path.join(workdir, "gang")
         )
     except GangFailure as e:
         failure = e
     finally:
+        # Rank 1 dumped flight_1.json before entering the stall loop; the
+        # driver's monitor dumped flight_driver.json when it detected the
+        # missed heartbeats.
+        flight = _flight_info(tdir, 1)
+        driver_flight = _flight_info(tdir, "driver")
         _clear_plan()
     return {
         "scenario": "gang_stall",
@@ -115,15 +170,22 @@ def scenario_gang_stall(workdir: str) -> dict:
         "detected": failure is not None,
         "cause": failure.cause if failure else None,
         "rank": failure.rank if failure else None,
+        "flight": flight,
+        "driver_flight": driver_flight,
         "wall_seconds": round(time.monotonic() - t0, 2),
-        "ok": failure is not None
-        and failure.cause == "heartbeat"
-        and failure.rank == 1,
+        "ok": (
+            failure is not None
+            and failure.cause == "heartbeat"
+            and failure.rank == 1
+            and flight["exists"]
+            and flight["events"] > 0
+            and driver_flight["exists"]
+            and driver_flight["events"] > 0
+        ),
     }
 
 
 def scenario_serving_poison(workdir: str) -> dict:
-    del workdir
     import jax
     import numpy as np
 
@@ -154,6 +216,11 @@ def scenario_serving_poison(workdir: str) -> dict:
     translator = Translator(model, params, src_pipe, trg_pipe)
 
     plan = "raise@decode_batch:batch=0"
+    # In-process (no gang rank), so the quarantine's flight dump lands in
+    # flight_driver.json — point the telemetry dir at this drill's workdir.
+    tdir = os.path.join(workdir, "telemetry")
+    os.makedirs(tdir, exist_ok=True)
+    os.environ["MLSPARK_TELEMETRY_DIR"] = tdir
     faults.install(faults.FaultPlan.from_spec(plan))
     texts = [s for s, _ in pairs][:12]
     try:
@@ -174,6 +241,8 @@ def scenario_serving_poison(workdir: str) -> dict:
             slots_leaked = eng.pool.in_use
     finally:
         faults.clear()
+        flight = _flight_info(tdir, "driver")
+        os.environ.pop("MLSPARK_TELEMETRY_DIR", None)
     return {
         "scenario": "serving_poison",
         "plan": plan,
@@ -184,6 +253,7 @@ def scenario_serving_poison(workdir: str) -> dict:
         "loop_restarts": summary["loop_restarts"],
         "recompiles_after_warmup": recompiles,
         "kv_slots_leaked": slots_leaked,
+        "flight": flight,
         "wall_seconds": round(time.monotonic() - t0, 2),
         "ok": (
             0 < failed <= 4
@@ -192,6 +262,8 @@ def scenario_serving_poison(workdir: str) -> dict:
             and summary["loop_restarts"] == 0
             and recompiles == 0
             and slots_leaked == 0
+            and flight["exists"]
+            and flight["events"] > 0
         ),
     }
 
@@ -205,7 +277,7 @@ SCENARIOS = {
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
-    ap.add_argument("--out", default="FAULTS_r01.json")
+    ap.add_argument("--out", default="FAULTS_r02.json")
     ap.add_argument(
         "scenarios", nargs="*", default=None,
         help=f"subset to run (default: all of {sorted(SCENARIOS)})",
@@ -225,7 +297,7 @@ def main() -> int:
 
     report = {
         "artifact": "FAULTS",
-        "round": 1,
+        "round": 2,
         "all_ok": all(r["ok"] for r in results),
         "scenarios": results,
     }

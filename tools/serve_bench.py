@@ -159,6 +159,13 @@ def main() -> None:
             rows.append(row)
             print(json.dumps(row), flush=True)
 
+        # Every request the bench ever submitted must be accounted for:
+        # submitted == completed + rejected + expired + failed (+ in-flight,
+        # which is zero after the drain above). Raises ConservationError on
+        # a leak, failing the bench the way a test failure would.
+        ledger = engine.metrics.check_conservation(in_flight=0)
+        print(json.dumps({"conservation": ledger}), flush=True)
+
         artifact = {
             "bench": "serve",
             "smoke": smoke,
@@ -170,6 +177,7 @@ def main() -> None:
             "rows": rows,
             "recompiles_after_warmup": engine.recompiles_after_warmup,
             "engine_summary": engine.metrics.summary(),
+            "conservation": ledger,
         }
     with open(out_path, "w") as fh:
         json.dump(artifact, fh, indent=1)
