@@ -335,6 +335,74 @@ def _with_deadline(fn, seconds: float, label: str):
     return box["result"]
 
 
+def _sweep_point_cmd(bpc: int, layers: int) -> list[str]:
+    """Argv for one isolated sweep point — module-level so tests can swap
+    in a stub child."""
+    return [
+        sys.executable, os.path.abspath(__file__),
+        "--sweep-point", f"{bpc}x{layers}",
+    ]
+
+
+def _run_point_isolated(bpc: int, layers: int, deadline: float) -> dict:
+    """Run one sweep point in its OWN process under a hard timeout.
+
+    The r05 artifact ended in ``{"truncated": "hung point"}``: a compile
+    wedged inside ``_with_deadline`` can only be *abandoned*, and the
+    orphan thread still owns the chip once its RPC un-wedges, so the
+    in-process sweep has no choice but to quarantine after one hang. A
+    subprocess dies WITH its wedged compile (killpg on timeout), leaving
+    the chip free — one hang costs one ``{"error": ...}`` row and the
+    sweep continues to the next point instead of truncating the artifact.
+    """
+    import subprocess
+
+    proc = subprocess.Popen(
+        _sweep_point_cmd(bpc, layers),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,  # killpg must reach the child's own spawns
+    )
+    try:
+        out, err = proc.communicate(timeout=deadline)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.communicate()
+        raise TimeoutError(
+            f"sweep point bs={bpc} L={layers} deadline "
+            f"({deadline:.0f}s) exceeded; child killed"
+        ) from None
+    if proc.returncode != 0:
+        tail = " | ".join((err or out or "").strip().splitlines()[-5:])
+        raise RuntimeError(
+            f"sweep point bs={bpc} L={layers} exited {proc.returncode}: {tail}"
+        )
+    lines = [ln for ln in (out or "").splitlines() if ln.strip()]
+    if not lines:
+        raise RuntimeError(f"sweep point bs={bpc} L={layers}: no output")
+    return json.loads(lines[-1])
+
+
+def _sweep_point_main(token: str) -> int:
+    """Child mode for ``_run_point_isolated``: run ONE sweep point and
+    print its ``bench_transformer`` dict as the last stdout line. Backend
+    init follows the same probe/fallback path as ``main()`` (so
+    ``BENCH_PLATFORM=cpu`` smoke children stay on CPU)."""
+    b, layers = token.strip().lower().split("x")
+    jax = _init_backend()
+    _degraded_mode_knobs(jax)
+    r = bench_transformer(
+        jax, batch_per_chip=int(b), layers=int(layers),
+        trials=2, steps=10, warmup=5,
+    )
+    print(json.dumps(r))
+    return 0
+
+
 def _transient_retry(fn, label: str, attempts: int = 2):
     """Retry a bench workload once after a transient tunnel RPC failure.
 
@@ -1081,6 +1149,18 @@ def bench_transformer_sweep(
     """
     points = [] if points is None else points
     point_deadline = float(os.environ.get("BENCH_SWEEP_POINT_DEADLINE", "300"))
+    # Per-point process isolation (see _run_point_isolated): default ON
+    # for a real chip — that's where compiles hang — and OFF on CPU, where
+    # the in-process path is cheaper and tests monkeypatch
+    # bench_transformer directly. BENCH_SWEEP_ISOLATE overrides both ways.
+    iso_env = os.environ.get("BENCH_SWEEP_ISOLATE")
+    if iso_env is not None:
+        isolate = iso_env.strip().lower() not in ("", "0", "false", "no")
+    else:
+        try:
+            isolate = jax.devices()[0].platform == "tpu"
+        except Exception:
+            isolate = False
     # BENCH_SWEEP_POINTS="32x4,128x4" makes the plan exactly those
     # (batch_per_chip x layers) points, in order — chip windows through the
     # tunnel are scarce, and a re-capture of points a hang stole must not
@@ -1116,14 +1196,17 @@ def bench_transformer_sweep(
             points.append({"truncated": "time budget"})
             return points
         try:
-            r = _with_deadline(
-                lambda: bench_transformer(
-                    jax, batch_per_chip=bpc, layers=layers,
-                    trials=2, steps=10, warmup=5,
-                ),
-                point_deadline,
-                f"sweep bs={bpc} L={layers}",
-            )
+            if isolate:
+                r = _run_point_isolated(bpc, layers, point_deadline)
+            else:
+                r = _with_deadline(
+                    lambda: bench_transformer(
+                        jax, batch_per_chip=bpc, layers=layers,
+                        trials=2, steps=10, warmup=5,
+                    ),
+                    point_deadline,
+                    f"sweep bs={bpc} L={layers}",
+                )
             points.append({
                 "batch_per_chip": bpc,
                 "layers": layers,
@@ -1140,6 +1223,15 @@ def bench_transformer_sweep(
             )
         except Exception as e:
             log(f"sweep point bs={bpc} layers={layers} failed: {e!r}")
+            if isolate:
+                # The hung/broken compile died with its process; the chip
+                # is free, so this point's failure is ITS failure alone —
+                # record the casualty row and keep sweeping.
+                points.append({
+                    "batch_per_chip": bpc, "layers": layers,
+                    "error": repr(e), "isolated": True,
+                })
+                continue
             points.append({
                 "batch_per_chip": bpc, "layers": layers, "error": repr(e),
             })
@@ -1552,11 +1644,13 @@ def main() -> None:
                 suspect = suspect or isinstance(e, TimeoutError)
     if not suspect:
         # A point that hung inside the sweep's own loop quarantines too
-        # (the sweep returns normally after recording it).
+        # (the sweep returns normally after recording it) — unless the
+        # point ran isolated, where the hang died with its own process and
+        # the chip this process holds was never touched.
         suspect = any(
             "TimeoutError" in p.get("error", "")
             for p in (result.get("sweep") or [])
-            if isinstance(p, dict)
+            if isinstance(p, dict) and not p.get("isolated")
         )
     try:
         # CNN runs on whatever the ledger has left (its reserve), capped by
@@ -1597,4 +1691,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--sweep-point":
+        sys.exit(_sweep_point_main(sys.argv[2]))
     main()

@@ -149,6 +149,7 @@ class Distributor:
         use_gpu: bool = False,  # noqa: ARG002 - API parity
         platform: str | None = None,
         env: dict[str, str] | None = None,
+        dp_mode: str | None = None,
         timeout: float = 600.0,
         max_restarts: int = 0,
         heartbeat_interval: float = 1.0,
@@ -161,6 +162,18 @@ class Distributor:
         self.local_mode = local_mode
         self.platform = platform
         self.extra_env = env or {}
+        # Data-parallel update mode for the workers' fit() (parallel.zero
+        # env contract): "zero1" opts the whole gang into the fused
+        # sharded-update step via MLSPARK_DP_MODE. Kept as a first-class
+        # knob (not just env=) so driver scripts read as intent, and
+        # validated here — a typo must fail at Distributor construction,
+        # not inside every worker after rendezvous.
+        if dp_mode is not None and dp_mode not in ("replicated", "zero1"):
+            raise ValueError(
+                f"unknown dp_mode {dp_mode!r} (expected 'replicated' or "
+                "'zero1')"
+            )
+        self.dp_mode = dp_mode
         self.timeout = timeout
         # Spark-barrier recovery semantics (SURVEY.md §5 failure detection):
         # a failed stage is retried whole — all-or-nothing gang restarts.
@@ -339,6 +352,12 @@ class Distributor:
                     env["XLA_FLAGS"] = kept
                 else:
                     env.pop("XLA_FLAGS", None)
+            # DP-mode plumbing: the constructor knob becomes the workers'
+            # MLSPARK_DP_MODE (fit() resolves it when dp_mode isn't passed
+            # explicitly); an inherited MLSPARK_DP_MODE flows through
+            # dict(os.environ) above, and explicit env= still wins below.
+            if self.dp_mode is not None:
+                env["MLSPARK_DP_MODE"] = self.dp_mode
             env.update(self.extra_env)
             # Workers default their telemetry output (rank JSONLs, flight
             # dumps) next to the heartbeat files; an inherited or explicit

@@ -131,6 +131,20 @@ def main(argv: list[str] | None = None) -> int:
 
         _install_sigterm_flight(tm, rank)
 
+        # Record the gang's data-parallel update contract on this rank's
+        # timeline (MLSPARK_DP_MODE / bucket / comms-dtype — set by
+        # Distributor(dp_mode=...) or inherited; consumed by fit() via
+        # parallel.zero.resolve_dp_mode). The merged telemetry report's
+        # comms section reads next to this breadcrumb.
+        dp_mode = os.environ.get("MLSPARK_DP_MODE")
+        if dp_mode:
+            tm.annotate(
+                "launcher.dp_mode",
+                mode=dp_mode,
+                bucket_bytes=os.environ.get("MLSPARK_ZERO1_BUCKET_BYTES"),
+                comms_dtype=os.environ.get("MLSPARK_COMMS_DTYPE"),
+            )
+
         # Rendezvous before user code touches devices — the
         # dist.init_process_group analogue (distributed_cnn.py:152).
         from machine_learning_apache_spark_tpu.launcher.coordinator import (

@@ -27,6 +27,17 @@ from machine_learning_apache_spark_tpu.parallel.data_parallel import (
     pad_batch_to_multiple,
     params_fingerprint,
 )
+from machine_learning_apache_spark_tpu.parallel.zero import (
+    DP_MODES,
+    Zero1Config,
+    Zero1State,
+    init_sharded,
+    make_zero1_step,
+    opt_state_bytes,
+    opt_state_bytes_per_chip,
+    resolve_dp_mode,
+    shard_optimizer_state,
+)
 from machine_learning_apache_spark_tpu.parallel.pipeline_parallel import (
     pipeline_apply,
 )
@@ -67,6 +78,15 @@ __all__ = [
     "make_data_parallel_step",
     "pad_batch_to_multiple",
     "params_fingerprint",
+    "DP_MODES",
+    "Zero1Config",
+    "Zero1State",
+    "init_sharded",
+    "make_zero1_step",
+    "opt_state_bytes",
+    "opt_state_bytes_per_chip",
+    "resolve_dp_mode",
+    "shard_optimizer_state",
     "pipeline_apply",
     "pipeline_transformer_logits",
     "ring_attention",

@@ -127,9 +127,51 @@ def pad_batch_to_multiple(batch, multiple: int):
     return jax.tree.map(_pad, batch), n
 
 
+def _params_of(tree):
+    """Fingerprint the PARAMS only when handed a whole train state
+    (``TrainState``/``Zero1State``): the optimizer state may be legitimately
+    sharded (zero1) and must never poison a replication check."""
+    if hasattr(tree, "params") and hasattr(tree, "opt_state"):
+        return tree.params
+    return tree
+
+
+def _check_fingerprintable(params, *, require_replicated: bool) -> None:
+    """Clear errors instead of wrong answers: a leaf this process cannot
+    read whole (multi-process sharding) can't be fingerprinted, and a
+    sharded (non-replicated) tree must never enter the cross-replica sync
+    check — each process would hash different data and the allgather would
+    compare apples to oranges."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        if not isinstance(leaf, jax.Array):
+            continue
+        where = jax.tree_util.keystr(path)
+        if not leaf.is_fully_addressable:
+            raise ValueError(
+                f"params_fingerprint: leaf {where} is sharded across "
+                "processes and cannot be read whole here; fingerprint "
+                "state.params (replicated), not a sharded tree"
+            )
+        if (
+            require_replicated
+            and len(leaf.sharding.device_set) > 1
+            and not leaf.is_fully_replicated
+        ):
+            raise ValueError(
+                f"assert_replicas_in_sync: leaf {where} is sharded "
+                f"({leaf.sharding}), not replicated — the cross-process "
+                "fingerprint comparison is only meaningful for replicated "
+                "params. Pass state.params (zero1 keeps params replicated; "
+                "its sharded optimizer state must stay out of this check)."
+            )
+
+
 def params_fingerprint(params) -> float:
     """Order-stable scalar fingerprint of a param pytree (sum of |p| per leaf,
-    combined) — cheap to compare across processes."""
+    combined) — cheap to compare across processes. Accepts a bare params
+    tree or a whole train state (params-only fingerprint)."""
+    params = _params_of(params)
+    _check_fingerprintable(params, require_replicated=False)
     leaves = jax.tree.leaves(params)
     total = 0.0
     for i, p in enumerate(leaves):
@@ -144,8 +186,14 @@ def assert_replicas_in_sync(params, *, atol: float = 1e-6) -> float:
     raw module bypassing DDP sync, ``distributed_cnn.py:175``). Single-process
     runs (single-controller semantics: one logical copy) pass trivially.
 
+    Accepts a bare params tree or a whole train state (only ``.params`` is
+    checked); raises ``ValueError`` on a non-replicated tree rather than
+    allgathering fingerprints of different data.
+
     Returns the max cross-process divergence.
     """
+    params = _params_of(params)
+    _check_fingerprintable(params, require_replicated=True)
     fp = params_fingerprint(params)
     if jax.process_count() == 1:
         return 0.0

@@ -1,0 +1,534 @@
+"""ZeRO-1 sharded weight update for the data-parallel path.
+
+``make_data_parallel_step`` replicates everything: every chip holds the
+full params *and* the full optimizer moments and pays a full-gradient
+allreduce per step. "Automatic Cross-Replica Sharding of Weight Update
+in Data-Parallel Training" (arxiv 2004.13336, PAPERS.md) observes the
+allreduce is a reduce-scatter + allgather in disguise, and the weight
+update between the two halves only ever needs 1/N of the gradient — so
+each chip can own 1/N of the parameters for update purposes and the
+moments shrink by N with bit-equal convergence semantics:
+
+    reduce_scatter(grads) -> tx.update on this chip's shard -> allgather(params)
+
+This module is the explicit fused form of that rewrite (the implicit
+form — ``tensor_parallel.shard_state(zero1=True)``, XLA propagation —
+predates it and stays supported as ``fit(zero1=True)``):
+
+- gradients are flattened into one fp32 vector and cut into **buckets**
+  (``bucket_bytes``; DDP's bucketing, SURVEY.md §2.2) so the
+  reduce-scatter pipelines instead of waiting for the full gradient; the
+  ragged tail is zero-padded inside the fused step, never on the host;
+- each bucket optionally travels in a compressed ``comms_dtype`` —
+  ``bfloat16``, or ``int8`` with a per-bucket scale chosen so the N-way
+  sum cannot overflow (EQuARX, arxiv 2506.17615) — while params and
+  moments accumulate in fp32 (master copies);
+- the optimizer state is built **sharded from the start**
+  (``jit(out_shardings=...)`` over ``tx.init``): the replicated moments
+  never exist, so peak per-chip optimizer memory is ~1/N from step 0.
+
+Shard layout: device ``i`` owns the ``i``-th 1/N slice of *every
+bucket* (what ``psum_scatter`` hands it), concatenated. The flat
+optimizer-state leaves live in that bucket-major order; it is internally
+consistent across init/update/checkpoint and no caller reads them
+elementwise.
+
+Limitations (documented, checked where cheap): the optimizer chain must
+be elementwise per-parameter (sgd/adam/adamw + schedules are; a
+``clip_by_global_norm`` INSIDE ``tx`` would clip by the shard-local norm
+— pass ``grad_clip=`` here instead, which clips by the true global norm
+via a scalar psum); ``steps_per_call`` fusion and MultiSteps-style
+cross-step state are out of scope for the fused step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+from machine_learning_apache_spark_tpu.utils.jax_compat import shard_map
+
+# Environment contract (launcher gang plumbing: the driver sets these on
+# the Distributor, workers' fit() picks them up — docs/PARALLELISM.md).
+ENV_DP_MODE = "MLSPARK_DP_MODE"
+ENV_BUCKET_BYTES = "MLSPARK_ZERO1_BUCKET_BYTES"
+ENV_COMMS_DTYPE = "MLSPARK_COMMS_DTYPE"
+
+DP_MODES = ("replicated", "zero1")
+COMMS_DTYPES = ("float32", "bfloat16", "int8")
+
+#: DDP's default bucket is 25 MB; the models here are far smaller, and a
+#: 4 MiB bucket already gives the reduce-scatter several pipeline stages
+#: on every workload in the repo.
+DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+
+_WIRE_ITEMSIZE = {"float32": 4, "bfloat16": 2, "int8": 1}
+
+
+def resolve_dp_mode(dp_mode: str | None) -> str:
+    """Explicit argument > ``MLSPARK_DP_MODE`` env > ``"replicated"``."""
+    mode = dp_mode or os.environ.get(ENV_DP_MODE) or "replicated"
+    if mode not in DP_MODES:
+        raise ValueError(f"unknown dp_mode {mode!r} (expected one of {DP_MODES})")
+    return mode
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero1Config:
+    """Comms-efficiency knobs for the fused ZeRO-1 step."""
+
+    axis: str = DATA_AXIS
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES
+    comms_dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        if self.comms_dtype not in COMMS_DTYPES:
+            raise ValueError(
+                f"unknown comms_dtype {self.comms_dtype!r} "
+                f"(expected one of {COMMS_DTYPES})"
+            )
+        if self.bucket_bytes < 4:
+            raise ValueError(
+                f"bucket_bytes must hold at least one fp32 element, "
+                f"got {self.bucket_bytes}"
+            )
+
+    @classmethod
+    def from_env(
+        cls,
+        *,
+        axis: str = DATA_AXIS,
+        bucket_bytes: int | None = None,
+        comms_dtype: str | None = None,
+    ) -> "Zero1Config":
+        """Explicit arguments win; unset ones fall back to the launcher
+        env contract, then to defaults."""
+        if bucket_bytes is None:
+            bucket_bytes = int(
+                os.environ.get(ENV_BUCKET_BYTES, DEFAULT_BUCKET_BYTES)
+            )
+        if comms_dtype is None:
+            comms_dtype = os.environ.get(ENV_COMMS_DTYPE, "float32")
+        return cls(axis=axis, bucket_bytes=bucket_bytes, comms_dtype=comms_dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class _FlatPlan:
+    """Static description of the params-tree <-> flat-fp32-vector mapping.
+
+    Buckets partition ``[0, padded)``; every bucket length (and therefore
+    ``padded``) is a multiple of the axis size, so ``psum_scatter`` tiles
+    each bucket evenly and the zero pad lives entirely in the last bucket.
+    """
+
+    treedef: Any
+    shapes: tuple
+    dtypes: tuple
+    sizes: tuple
+    total: int
+    padded: int
+    shard_len: int
+    buckets: tuple  # ((start, stop), ...) in flat padded coordinates
+
+
+def make_flat_plan(params, axis_size: int, bucket_bytes: int) -> _FlatPlan:
+    leaves, treedef = jax.tree.flatten(params)
+    if not leaves:
+        raise ValueError("cannot build a ZeRO-1 plan for an empty params tree")
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    dtypes = tuple(jnp.dtype(l.dtype) for l in leaves)
+    sizes = tuple(int(l.size) for l in leaves)
+    total = sum(sizes)
+    # Bucket element counts are fp32-denominated (the master accumulation
+    # dtype) and rounded up to a multiple of the axis size so every
+    # bucket reduce-scatters evenly.
+    elems = max(bucket_bytes // 4, 1)
+    elems = -(-elems // axis_size) * axis_size
+    padded = -(-total // axis_size) * axis_size
+    buckets = tuple(
+        (start, min(start + elems, padded)) for start in range(0, padded, elems)
+    )
+    return _FlatPlan(
+        treedef=treedef,
+        shapes=shapes,
+        dtypes=dtypes,
+        sizes=sizes,
+        total=total,
+        padded=padded,
+        shard_len=padded // axis_size,
+        buckets=buckets,
+    )
+
+
+def _flatten(tree, plan: _FlatPlan):
+    """Params/grads tree -> one fp32 vector of length ``plan.padded``."""
+    leaves = jax.tree.leaves(tree)
+    flat = jnp.concatenate(
+        [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+    )
+    if plan.padded > plan.total:
+        flat = jnp.pad(flat, (0, plan.padded - plan.total))
+    return flat
+
+
+def _unflatten(flat, plan: _FlatPlan):
+    """Inverse of ``_flatten``: slice, reshape, and restore leaf dtypes."""
+    leaves = []
+    offset = 0
+    for shape, dtype, size in zip(plan.shapes, plan.dtypes, plan.sizes):
+        leaves.append(
+            flat[offset:offset + size].reshape(shape).astype(dtype)
+        )
+        offset += size
+    return jax.tree.unflatten(plan.treedef, leaves)
+
+
+def _opt_spec_tree(opt_shapes, axis: str):
+    """PartitionSpecs for an optimizer state built over the flat vector:
+    vector-shaped leaves shard over ``axis``, scalars (step counts)
+    replicate."""
+    return jax.tree.map(
+        lambda l: P(axis) if getattr(l, "ndim", 0) >= 1 else P(), opt_shapes
+    )
+
+
+def _reduce_scatter_bucket(seg, axis: str, axis_size: int, comms_dtype: str):
+    """One bucket's gradient reduce-scatter in the configured wire dtype.
+
+    fp32: exact. bf16: cast-reduce-cast (lossy mantissa, fp32 master state
+    untouched). int8: per-bucket scale chosen as ``pmax(|seg|) * N / 127``
+    so each shard contributes at most 127/N — the N-way integer sum can
+    never overflow int8 (the EQuARX trick, minus their block granularity).
+    """
+    if comms_dtype == "float32":
+        return jax.lax.psum_scatter(
+            seg, axis, scatter_dimension=0, tiled=True
+        )
+    if comms_dtype == "bfloat16":
+        piece = jax.lax.psum_scatter(
+            seg.astype(jnp.bfloat16), axis, scatter_dimension=0, tiled=True
+        )
+        return piece.astype(jnp.float32)
+    # int8 with per-bucket scale.
+    absmax = jax.lax.pmax(jnp.max(jnp.abs(seg)), axis)
+    scale = jnp.maximum(absmax * axis_size / 127.0, jnp.float32(1e-30))
+    q = jnp.clip(jnp.round(seg / scale), -127, 127).astype(jnp.int8)
+    piece = jax.lax.psum_scatter(q, axis, scatter_dimension=0, tiled=True)
+    return piece.astype(jnp.float32) * scale
+
+
+def comms_bytes_per_step(plan: _FlatPlan, config: Zero1Config) -> dict:
+    """Static wire accounting for one fused step (what the telemetry
+    counters report): reduce-scatter payload in the wire dtype (+4 bytes
+    per int8 bucket for the scale), allgather of the updated fp32 params.
+    """
+    wire = _WIRE_ITEMSIZE[config.comms_dtype]
+    rs = plan.padded * wire
+    if config.comms_dtype == "int8":
+        rs += 4 * len(plan.buckets)
+    return {
+        "reduce_scatter_bytes": rs,
+        "allgather_bytes": plan.padded * 4,
+        "grad_bytes_fp32": plan.padded * 4,
+        "n_buckets": len(plan.buckets),
+        "bucket_bytes": config.bucket_bytes,
+        "comms_dtype": config.comms_dtype,
+        "padded_elems": plan.padded,
+        "pad_elems": plan.padded - plan.total,
+    }
+
+
+class Zero1State(struct.PyTreeNode):
+    """TrainState analogue for the fused ZeRO-1 step: params replicated,
+    optimizer state flat (fp32, bucket-major shard layout) and sharded
+    1/N over the data axis. Same field names as ``TrainState`` where the
+    semantics coincide, so ``fit``/checkpointing address both uniformly.
+    """
+
+    step: jax.Array | int
+    params: Any
+    opt_state: Any
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    plan: _FlatPlan = struct.field(pytree_node=False)
+    config: Zero1Config = struct.field(pytree_node=False)
+
+
+def _require_zero1_mesh(mesh: Mesh, axis: str) -> int:
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"zero1 needs a mesh with a {axis!r} axis; got {mesh.axis_names}"
+        )
+    axis_size = mesh.shape[axis]
+    if axis_size <= 1:
+        raise ValueError(
+            f"zero1 needs a >1 {axis!r} axis to shard over; got {axis_size} "
+            f"(mesh {dict(mesh.shape)})"
+        )
+    other = {a: s for a, s in mesh.shape.items() if a != axis and s > 1}
+    if other:
+        raise ValueError(
+            "dp_mode='zero1' is the pure data-parallel sharded-update path; "
+            f"mesh has extra >1 axes {other} — use shard_state(zero1=True) "
+            "for hybrid dp x tp meshes"
+        )
+    return axis_size
+
+
+def init_sharded(
+    *,
+    apply_fn: Callable,
+    params,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    config: Zero1Config | None = None,
+) -> Zero1State:
+    """Build a ``Zero1State`` whose optimizer state is sharded from the
+    start: ``tx.init`` runs under ``jit(out_shardings=1/N)`` over the flat
+    fp32 vector, so XLA materializes each moment directly as N shards —
+    the replicated copy never exists on any chip. Params are placed
+    replicated on the mesh (ZeRO-1 keeps whole-replica params).
+    """
+    config = config or Zero1Config()
+    axis_size = _require_zero1_mesh(mesh, config.axis)
+    plan = make_flat_plan(params, axis_size, config.bucket_bytes)
+
+    flat_spec = jax.ShapeDtypeStruct((plan.padded,), jnp.float32)
+    opt_shapes = jax.eval_shape(tx.init, flat_spec)
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        _opt_spec_tree(opt_shapes, config.axis),
+    )
+
+    @functools.partial(jax.jit, out_shardings=shardings)
+    def _init():
+        return tx.init(jnp.zeros((plan.padded,), jnp.float32))
+
+    params = jax.device_put(params, NamedSharding(mesh, P()))
+    return Zero1State(
+        step=0,
+        params=params,
+        opt_state=_init(),
+        apply_fn=apply_fn,
+        tx=tx,
+        plan=plan,
+        config=config,
+    )
+
+
+def shard_optimizer_state(
+    state, mesh: Mesh, config: Zero1Config | None = None
+) -> Zero1State:
+    """``TrainState -> Zero1State`` entry point for ``fit(dp_mode="zero1")``.
+
+    The optimizer state is re-initialized sharded (``init_sharded``), not
+    migrated: for a fresh ``TrainState.create`` the moments are zeros in
+    both layouts, so this is lossless; converting a mid-run state would
+    silently reset its moments, so that raises.
+    """
+    if isinstance(state, Zero1State):
+        return state
+    if int(jax.device_get(state.step)) != 0:
+        raise ValueError(
+            "shard_optimizer_state re-initializes the optimizer moments "
+            f"(sharded from the start); converting a mid-run state at step "
+            f"{int(jax.device_get(state.step))} would silently discard them. "
+            "Start zero1 runs from a fresh state (resume restores into the "
+            "sharded layout afterwards)."
+        )
+    return init_sharded(
+        apply_fn=state.apply_fn, params=state.params, tx=state.tx,
+        mesh=mesh, config=config,
+    )
+
+
+def make_zero1_step(
+    loss_fn: Callable,
+    mesh: Mesh,
+    state: Zero1State,
+    *,
+    grad_clip: float | None = None,
+):
+    """Fused ZeRO-1 train step: reduce-scatter(grads) -> 1/N optimizer
+    update -> allgather(params), one compiled program.
+
+    Same calling convention as ``make_data_parallel_step``'s result —
+    ``step(state, batch, rng) -> (state, loss, aux)`` with ``state``
+    donated — but the state must be a ``Zero1State`` (``init_sharded`` /
+    ``shard_optimizer_state``); the step specializes to its flat plan,
+    optimizer, and comms config at construction. Per-shard loss/grad
+    math is identical to the replicated step (same ``fold_in`` rng
+    decorrelation, same ``loss / N`` scaling), so with
+    ``comms_dtype="float32"`` the two modes walk the same trajectory
+    (tests/test_zero.py pins it).
+
+    ``grad_clip`` applies optax's ``clip_by_global_norm`` rule using the
+    TRUE global norm (shard-local sum of squares psummed over the axis) —
+    the one cross-parameter coupling the sharded update cannot express
+    inside ``tx`` itself.
+
+    The returned step carries ``step.comms_stats`` (static wire-byte
+    accounting per step) for the telemetry counters.
+    """
+    if not isinstance(state, Zero1State):
+        raise TypeError(
+            "make_zero1_step needs a Zero1State (init_sharded / "
+            f"shard_optimizer_state), got {type(state).__name__}"
+        )
+    config = state.config
+    plan = state.plan
+    tx = state.tx
+    axis = config.axis
+    axis_size = _require_zero1_mesh(mesh, axis)
+    if plan.padded % axis_size:
+        raise ValueError(
+            f"state plan (padded={plan.padded}) does not divide the mesh's "
+            f"{axis!r} axis ({axis_size}); the state was built for a "
+            "different mesh"
+        )
+
+    def per_shard(params, opt_state, batch, rng):
+        idx = jax.lax.axis_index(axis)
+        rng = jax.random.fold_in(rng, idx)
+
+        def scaled_loss(p):
+            loss, aux = loss_fn(p, batch, rng)
+            return loss / axis_size, (loss, aux)
+
+        (_, (loss, aux)), grads = jax.value_and_grad(
+            scaled_loss, has_aux=True
+        )(params)
+        loss = jax.lax.pmean(loss, axis)
+        aux = jax.tree.map(lambda x: jax.lax.pmean(x, axis), aux)
+
+        # Bucketed reduce-scatter: after this, this chip holds the
+        # global-mean gradient for its 1/N slice of every bucket.
+        flat_g = _flatten(grads, plan)
+        g_pieces = [
+            _reduce_scatter_bucket(
+                flat_g[s:e], axis, axis_size, config.comms_dtype
+            )
+            for s, e in plan.buckets
+        ]
+        g_shard = jnp.concatenate(g_pieces)
+
+        if grad_clip is not None:
+            # Shard pieces tile the padded vector exactly once, so the
+            # psum of local sums-of-squares IS the global norm -- one
+            # scalar collective, exactly optax.clip_by_global_norm.
+            g_norm = jnp.sqrt(
+                jax.lax.psum(jnp.sum(jnp.square(g_shard)), axis)
+            )
+            scale = jnp.where(g_norm < grad_clip, 1.0, grad_clip / g_norm)
+            g_shard = g_shard * scale
+
+        # This chip's matching param shard (same bucket-major layout).
+        flat_p = _flatten(params, plan)
+        p_pieces = [
+            jax.lax.dynamic_slice_in_dim(
+                flat_p,
+                s + idx * ((e - s) // axis_size),
+                (e - s) // axis_size,
+            )
+            for s, e in plan.buckets
+        ]
+        p_shard = jnp.concatenate(p_pieces)
+
+        updates, new_opt = tx.update(g_shard, opt_state, p_shard)
+        new_p_shard = optax.apply_updates(p_shard, updates)
+
+        # Allgather per bucket piece: tiled gather in device order
+        # reconstructs each bucket segment contiguously.
+        new_segments = []
+        offset = 0
+        for s, e in plan.buckets:
+            piece_len = (e - s) // axis_size
+            piece = new_p_shard[offset:offset + piece_len]
+            offset += piece_len
+            new_segments.append(
+                jax.lax.all_gather(piece, axis, tiled=True)
+            )
+        flat_new = jnp.concatenate(new_segments)
+        return _unflatten(flat_new, plan), new_opt, loss, aux
+
+    flat_spec = jax.ShapeDtypeStruct((plan.padded,), jnp.float32)
+    opt_specs = _opt_spec_tree(jax.eval_shape(tx.init, flat_spec), axis)
+    sharded = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), opt_specs, P(axis), P()),
+        out_specs=(P(), opt_specs, P(), P()),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def _step(zstate: Zero1State, batch, rng: jax.Array):
+        new_params, new_opt, loss, aux = sharded(
+            zstate.params, zstate.opt_state, batch, rng
+        )
+        return (
+            zstate.replace(
+                step=zstate.step + 1, params=new_params, opt_state=new_opt
+            ),
+            loss,
+            aux,
+        )
+
+    def step(zstate: Zero1State, batch, rng: jax.Array):
+        return _step(zstate, batch, rng)
+
+    step.comms_stats = comms_bytes_per_step(plan, config)
+    return step
+
+
+def opt_state_bytes(opt_state) -> int:
+    """Logical (unsharded) byte size of an optimizer-state tree — the
+    replicated-mode per-chip footprint."""
+    return sum(
+        int(l.size) * jnp.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(opt_state)
+        if hasattr(l, "dtype")
+    )
+
+
+def opt_state_bytes_per_chip(state) -> int:
+    """Measured per-device optimizer-state residency: max over devices of
+    the bytes of addressable shard data. For a replicated state this
+    equals ``opt_state_bytes``; for a ZeRO-1 state it is ~1/N of it."""
+    per_device: dict = {}
+    for leaf in jax.tree.leaves(state.opt_state):
+        if not isinstance(leaf, jax.Array):
+            continue
+        for shard in leaf.addressable_shards:
+            per_device[shard.device] = (
+                per_device.get(shard.device, 0) + shard.data.nbytes
+            )
+    return max(per_device.values(), default=0)
+
+
+__all__ = [
+    "COMMS_DTYPES",
+    "DEFAULT_BUCKET_BYTES",
+    "DP_MODES",
+    "ENV_BUCKET_BYTES",
+    "ENV_COMMS_DTYPE",
+    "ENV_DP_MODE",
+    "Zero1Config",
+    "Zero1State",
+    "comms_bytes_per_step",
+    "init_sharded",
+    "make_flat_plan",
+    "make_zero1_step",
+    "opt_state_bytes",
+    "opt_state_bytes_per_chip",
+    "resolve_dp_mode",
+    "shard_optimizer_state",
+]

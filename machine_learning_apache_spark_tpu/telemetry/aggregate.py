@@ -151,9 +151,49 @@ def skew_report(table: dict) -> dict:
     return report
 
 
+def comms_report(events: list[dict], table: dict | None = None) -> dict:
+    """Comms rollup for the gang report: per-rank totals of the ``comms.*``
+    counter events (wire bytes the zero1 step moved, with bytes/step where
+    the emitter recorded a step count in ``attrs``) plus the duration
+    stats of any ``comms.*`` span phases (the collective p50/p99 the
+    comms-bench emits). Empty dicts when the run had no comms activity —
+    the renderer then omits the section's tables."""
+    table = phase_table(events) if table is None else table
+    counters: dict[str, dict] = {}
+    for ev in events:
+        name = str(ev.get("name", ""))
+        if ev.get("kind") != "counter" or not name.startswith("comms."):
+            continue
+        per_rank = counters.setdefault(name, {})
+        entry = per_rank.setdefault(
+            ev.get("rank"), {"total": 0.0, "steps": 0}
+        )
+        entry["total"] += float(ev.get("value") or 0.0)
+        entry["steps"] += int((ev.get("attrs") or {}).get("steps") or 0)
+    for per_rank in counters.values():
+        for entry in per_rank.values():
+            entry["per_step"] = (
+                round(entry["total"] / entry["steps"], 1)
+                if entry["steps"] else None
+            )
+    return {
+        "counters": {
+            name: dict(sorted(
+                per_rank.items(), key=lambda kv: (kv[0] is None, kv[0])
+            ))
+            for name, per_rank in sorted(counters.items())
+        },
+        "collectives": {
+            phase: entry
+            for phase, entry in table.items()
+            if phase.startswith("comms.")
+        },
+    }
+
+
 def merge_gang_dir(directory: str) -> dict:
     """One-call report over a gang workdir: find rank files, merge, build
-    the phase table and skew report."""
+    the phase table, skew report, and comms rollup."""
     paths = find_rank_files(directory)
     events = merge_rank_files(paths)
     table = phase_table(events)
@@ -164,6 +204,7 @@ def merge_gang_dir(directory: str) -> dict:
         "event_count": len(events),
         "phases": table,
         "skew": skew_report(table),
+        "comms": comms_report(events, table),
     }
 
 
@@ -211,10 +252,40 @@ def render_markdown(report: dict) -> str:
             )
     else:
         lines.append("(no phase seen on more than one rank)")
+    comms = report.get("comms") or {}
+    if comms.get("counters") or comms.get("collectives"):
+        lines += ["", "## Comms", ""]
+        if comms.get("counters"):
+            lines.append("| counter | rank | total bytes | steps | bytes/step |")
+            lines.append("|---|---|---|---|---|")
+            for name, per_rank in comms["counters"].items():
+                for rank, entry in per_rank.items():
+                    per_step = entry.get("per_step")
+                    lines.append(
+                        f"| {name} | {rank} | {int(entry['total'])} "
+                        f"| {entry['steps'] or '-'} "
+                        f"| {per_step if per_step is not None else '-'} |"
+                    )
+        if comms.get("collectives"):
+            lines.append("")
+            lines.append("| collective | rank | count | mean | p50 | p99 |")
+            lines.append("|---|---|---|---|---|---|")
+            for phase, entry in comms["collectives"].items():
+                o = entry["overall"]
+                lines.append(
+                    f"| {phase} | all | {o['count']} | {_fmt(o['mean'])} "
+                    f"| {_fmt(o['p50'])} | {_fmt(o['p99'])} |"
+                )
+                for rank, s in entry["ranks"].items():
+                    lines.append(
+                        f"| {phase} | {rank} | {s['count']} | {_fmt(s['mean'])} "
+                        f"| {_fmt(s['p50'])} | {_fmt(s['p99'])} |"
+                    )
     return "\n".join(lines) + "\n"
 
 
 __all__ = [
+    "comms_report",
     "find_rank_files",
     "load_jsonl",
     "merge_gang_dir",

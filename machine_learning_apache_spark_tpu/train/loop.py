@@ -121,6 +121,56 @@ def _rng_from_meta(data: list[int]) -> jax.Array:
     return jax.random.wrap_key_data(jnp.asarray(data, dtype=jnp.uint32))
 
 
+def _with_comms_counters(zstep, state):
+    """Wrap the fused ZeRO-1 step with the comms telemetry contract
+    (docs/OBSERVABILITY.md): per-step wire-byte counters (static amounts —
+    no device sync), a per-chip optimizer-state-bytes gauge set once, and
+    one ``counter`` event per fit so the gang report
+    (``telemetry_report.py`` comms section) can compute bytes/step."""
+    if not telemetry.enabled():
+        return zstep
+    from machine_learning_apache_spark_tpu.parallel import zero as _zero
+
+    stats = zstep.comms_stats
+    reg = telemetry.get_registry()
+    reg.gauge("comms", "opt_state_bytes_per_chip").set(
+        _zero.opt_state_bytes_per_chip(state)
+    )
+    telemetry.annotate(
+        "comms.zero1",
+        **{k: v for k, v in stats.items() if k != "grad_bytes_fp32"},
+    )
+    rs = reg.counter("comms", "bytes_reduce_scattered")
+    ag = reg.counter("comms", "bytes_allgathered")
+    counted = [0]
+
+    def step(st, batch, rng):
+        out = zstep(st, batch, rng)
+        rs.inc(stats["reduce_scatter_bytes"])
+        ag.inc(stats["allgather_bytes"])
+        counted[0] += 1
+        return out
+
+    def flush():
+        if not counted[0]:
+            return
+        log_ = telemetry.get_log()
+        log_.emit(
+            "counter", "comms.bytes_reduce_scattered",
+            value=counted[0] * stats["reduce_scatter_bytes"],
+            attrs={"steps": counted[0], "comms_dtype": stats["comms_dtype"]},
+        )
+        log_.emit(
+            "counter", "comms.bytes_allgathered",
+            value=counted[0] * stats["allgather_bytes"],
+            attrs={"steps": counted[0], "comms_dtype": stats["comms_dtype"]},
+        )
+        counted[0] = 0
+
+    step.flush_comms = flush
+    return step
+
+
 def fit(
     state: TrainState,
     loss_fn: LossFn,
@@ -138,6 +188,9 @@ def fit(
     metrics_file: str | None = None,
     sync_check_every: int = 0,
     zero1: bool = False,
+    dp_mode: str | None = None,
+    dp_bucket_bytes: int | None = None,
+    dp_comms_dtype: str | None = None,
     steps_per_call: int = 1,
     prefetch_to_device: int = 0,
     resume: bool = False,
@@ -170,6 +223,18 @@ def fit(
     gang's replicas diverge. 0 (default) disables the check (it is a
     cross-host sync point).
 
+    ``dp_mode="zero1"`` (or env ``MLSPARK_DP_MODE=zero1`` — the launcher
+    gang plumbing) switches the data-parallel update to the fused ZeRO-1
+    step (``parallel.zero``): gradients reduce-scatter over the ``data``
+    axis, each chip updates its 1/N parameter shard (optimizer moments
+    sharded from the start — ~1/N the optimizer memory), updated params
+    allgather back. Same trajectory as the replicated step (bit-identical
+    with the default fp32 comms). ``dp_bucket_bytes`` /
+    ``dp_comms_dtype`` (env ``MLSPARK_ZERO1_BUCKET_BYTES`` /
+    ``MLSPARK_COMMS_DTYPE``) tune the gradient collective — see
+    docs/PARALLELISM.md for the tradeoffs. Distinct from the legacy
+    ``zero1=True`` flag (implicit opt-state sharding, replicated step).
+
     ``steps_per_call=K`` dispatches K batches per host→device call via a
     ``lax.scan``-fused step (``make_multi_step``) — same math, same rng
     stream, K× fewer dispatches; the win for small/fast models whose step
@@ -197,17 +262,49 @@ def fit(
     afterwards. Build from copied params if two fits must share an init.
     """
     from machine_learning_apache_spark_tpu.utils.profiling import StepWindowTracer
+    from machine_learning_apache_spark_tpu.parallel import zero as _zero
 
     emit = emit or log.info
     rng = rng if rng is not None else jax.random.key(0)
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+    mode = _zero.resolve_dp_mode(dp_mode)
+    if mode == "zero1":
+        # The fused sharded-update path (parallel.zero,
+        # docs/PARALLELISM.md): reduce-scatter grads, update this chip's
+        # 1/N param shard, allgather. Distinct from the legacy
+        # zero1=True flag, which shards the optimizer moments via XLA
+        # propagation but keeps the replicated allreduce step.
+        if mesh is None:
+            raise ValueError("dp_mode='zero1' requires a mesh (use_mesh=True)")
+        if zero1:
+            raise ValueError(
+                "pass either dp_mode='zero1' (fused reduce-scatter step) or "
+                "zero1=True (implicit opt-state sharding), not both"
+            )
+        if steps_per_call > 1:
+            raise ValueError(
+                "dp_mode='zero1' runs its own fused step; steps_per_call "
+                "fusion is not supported with it"
+            )
+    elif dp_bucket_bytes is not None or dp_comms_dtype is not None:
+        raise ValueError(
+            "dp_bucket_bytes/dp_comms_dtype only apply to dp_mode='zero1'"
+        )
     step_fn = make_train_step(loss_fn)
     multi_fn = make_multi_step(loss_fn) if steps_per_call > 1 else None
     tracer = StepWindowTracer(
         profile_dir, start=profile_window[0], stop=profile_window[1]
     )
-    if mesh is not None:
+    if mesh is not None and mode == "zero1":
+        config = _zero.Zero1Config.from_env(
+            bucket_bytes=dp_bucket_bytes, comms_dtype=dp_comms_dtype
+        )
+        state = _zero.shard_optimizer_state(state, mesh, config)
+        step_fn = _with_comms_counters(
+            _zero.make_zero1_step(loss_fn, mesh, state), state
+        )
+    elif mesh is not None:
         # Logical-annotation-aware placement: DP-only meshes replicate (DDP
         # whole-replica semantics); a mesh with a "model" axis tensor-shards
         # annotated params and their optimizer moments (SURVEY.md §2.3).
@@ -280,6 +377,10 @@ def fit(
             # jax profiler, or every later trace in this process fails to
             # start.
             tracer.close()
+            # Comms byte totals land on the event log even for a run that
+            # died mid-epoch (the flight recorder then carries them too).
+            if hasattr(step_fn, "flush_comms"):
+                step_fn.flush_comms()
         if not history and resume_meta.get("metrics"):
             # Already-complete resume (a gang retry where THIS rank had
             # finished before teardown): zero epochs remain, so report the

@@ -75,6 +75,15 @@ def make_optimizer(
     to ``update`` average their gradients and emit one real parameter update
     (zero updates in between), so ``fit`` needs no special handling — the
     effective batch is K × the loader batch.
+
+    ZeRO-1 composition (``fit(dp_mode="zero1")``): the sharded update runs
+    ``tx.update`` on each chip's 1/N gradient slice, so only elementwise
+    chains compose — a ``grad_clip`` baked in HERE would clip by the
+    shard-local norm, and ``accumulate_steps > 1`` keeps cross-element
+    counters per shard. Pass ``grad_clip=`` to
+    ``parallel.zero.make_zero1_step`` (a true global-norm clip via a scalar
+    psum) and leave both knobs off the optimizer for that mode; see
+    ``docs/PARALLELISM.md``.
     """
     if isinstance(learning_rate, (int, float)):
         lr = make_schedule(
@@ -124,6 +133,17 @@ class TrainState(struct.PyTreeNode):
     def create(cls, *, apply_fn, params, tx) -> "TrainState":
         return cls(
             step=0, params=params, opt_state=tx.init(params), apply_fn=apply_fn, tx=tx
+        )
+
+    @property
+    def opt_state_bytes(self) -> int:
+        """Logical (unsharded) optimizer-state size in bytes — the number
+        ZeRO-1 divides by the data-axis size; for the per-chip footprint
+        of a sharded state see ``parallel.zero.opt_state_bytes_per_chip``."""
+        return sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves(self.opt_state)
+            if hasattr(leaf, "nbytes")
         )
 
     def apply_gradients(self, grads) -> "TrainState":

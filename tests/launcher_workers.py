@@ -204,3 +204,12 @@ def multihost_probe():
         f"MULTIHOST_RESULT rank={rank} world={world} sum={float(gathered.sum())}",
         flush=True,
     )
+
+
+def echo_dp_mode():
+    """The zero1 env contract as a worker sees it (Distributor(dp_mode=...)
+    must plumb MLSPARK_DP_MODE into every rank's environment)."""
+    return {
+        "dp_mode": os.environ.get("MLSPARK_DP_MODE"),
+        "rank": int(os.environ.get("MLSPARK_PROCESS_ID", "-1")),
+    }

@@ -303,3 +303,74 @@ def test_sweep_points_env_restricts_plan(monkeypatch):
     points = bench.bench_transformer_sweep(jax=None)
     assert ran == [(32, 4), (128, 4)]
     assert [(p["batch_per_chip"], p["layers"]) for p in points] == ran
+
+
+def test_sweep_isolated_point_records_child_result(monkeypatch):
+    """BENCH_SWEEP_ISOLATE=1 runs each point via _run_point_isolated: the
+    child's LAST stdout line is the point's bench_transformer dict (earlier
+    lines are logging noise and must be ignored)."""
+    monkeypatch.setenv("BENCH_SWEEP_ISOLATE", "1")
+    monkeypatch.setenv("BENCH_SWEEP_POINTS", "32x4,128x4")
+    payload = json.dumps(
+        {"median": 2.0, "mfu": 0.2, "spread": 1.0, "paired_window": {}}
+    )
+    monkeypatch.setattr(
+        bench, "_sweep_point_cmd",
+        lambda bpc, layers: [
+            sys.executable, "-c", f"print('noise'); print({payload!r})",
+        ],
+    )
+    points = bench.bench_transformer_sweep(jax=None)
+    assert [(p["batch_per_chip"], p["layers"]) for p in points] == [
+        (32, 4), (128, 4),
+    ]
+    assert all(p["mfu"] == 0.2 for p in points)
+
+
+def test_sweep_isolated_hang_is_one_row_not_a_truncation(monkeypatch):
+    """The r05 failure mode, fixed: a hung point under isolation is killed
+    at BENCH_SWEEP_POINT_DEADLINE, costs ONE {"error": ...} row, and the
+    NEXT point still runs — no {"truncated": "hung point"} quarantine,
+    because the wedge died with its own process."""
+    monkeypatch.setenv("BENCH_SWEEP_ISOLATE", "1")
+    monkeypatch.setenv("BENCH_SWEEP_POINTS", "32x4,128x4")
+    monkeypatch.setenv("BENCH_SWEEP_POINT_DEADLINE", "1")
+    payload = json.dumps(
+        {"median": 2.0, "mfu": 0.2, "spread": 1.0, "paired_window": {}}
+    )
+
+    def cmd(bpc, layers):
+        if bpc == 32:  # first point hangs past the 1s deadline
+            return [sys.executable, "-c", "import time; time.sleep(60)"]
+        return [sys.executable, "-c", f"print({payload!r})"]
+
+    monkeypatch.setattr(bench, "_sweep_point_cmd", cmd)
+    points = bench.bench_transformer_sweep(jax=None)
+    assert len(points) == 2
+    assert points[0]["isolated"] and "TimeoutError" in points[0]["error"]
+    assert "truncated" not in points[0] and "truncated" not in points[1]
+    assert points[1]["mfu"] == 0.2
+
+
+def test_sweep_isolated_child_crash_costs_that_point_only(monkeypatch):
+    """A child that exits nonzero (OOM, import error) is an error row with
+    the stderr tail attached; the sweep moves on."""
+    monkeypatch.setenv("BENCH_SWEEP_ISOLATE", "1")
+    monkeypatch.setenv("BENCH_SWEEP_POINTS", "32x4,128x4")
+    payload = json.dumps(
+        {"median": 2.0, "mfu": 0.2, "spread": 1.0, "paired_window": {}}
+    )
+
+    def cmd(bpc, layers):
+        if bpc == 32:
+            return [
+                sys.executable, "-c",
+                "import sys; print('boom', file=sys.stderr); sys.exit(3)",
+            ]
+        return [sys.executable, "-c", f"print({payload!r})"]
+
+    monkeypatch.setattr(bench, "_sweep_point_cmd", cmd)
+    points = bench.bench_transformer_sweep(jax=None)
+    assert len(points) == 2
+    assert points[0]["isolated"] and "boom" in points[0]["error"]
+    assert points[1]["mfu"] == 0.2

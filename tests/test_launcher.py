@@ -60,6 +60,18 @@ class TestDistributorLocal:
         )
         assert out == {"rank": 0, "world": 2, "master": "127.0.0.1", "tag": "gang"}
 
+    def test_gang_dp_mode_env_plumbing(self):
+        # Distributor(dp_mode="zero1") sets MLSPARK_DP_MODE for every rank
+        # — the env contract fit() resolves via parallel.zero.
+        out = Distributor(
+            num_processes=2, platform="cpu", timeout=120, dp_mode="zero1"
+        ).run("launcher_workers:echo_dp_mode")
+        assert out == {"dp_mode": "zero1", "rank": 0}
+
+    def test_dp_mode_typo_rejected_at_construction(self):
+        with pytest.raises(ValueError, match="dp_mode"):
+            Distributor(num_processes=2, dp_mode="zero2")
+
     def test_gang_failure_raises(self):
         with pytest.raises(RuntimeError, match="worker exploded"):
             Distributor(num_processes=2, platform="cpu", timeout=120).run(

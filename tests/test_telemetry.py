@@ -330,6 +330,52 @@ class TestAggregation:
         assert [e["rank"] for e in merged] == [2, 2]
 
 
+class TestCommsReport:
+    """The comms rollup next to the rank-skew report: zero1 wire-byte
+    counters (with bytes/step from the emitter's step stamp) plus the
+    comms.* collective span phases."""
+
+    @pytest.fixture
+    def comms_dir(self, tmp_path):
+        d = str(tmp_path / "gang")
+        os.makedirs(d)
+        for rank in (0, 1):
+            path = _write_rank_jsonl(
+                d, rank, {"comms.reduce_scatter": [0.002, 0.002]}
+            )
+            with open(path, "a") as f:
+                f.write(json.dumps({
+                    "kind": "counter", "name": "comms.bytes_reduce_scattered",
+                    "ts": 1.0, "wall": 1e9, "rank": None, "pid": 1,
+                    "value": 4096.0, "attrs": {"steps": 4,
+                                               "comms_dtype": "float32"},
+                }) + "\n")
+        return d
+
+    def test_counters_and_collectives(self, comms_dir):
+        report = aggregate.merge_gang_dir(comms_dir)
+        comms = report["comms"]
+        per_rank = comms["counters"]["comms.bytes_reduce_scattered"]
+        assert per_rank[0] == {"total": 4096.0, "steps": 4, "per_step": 1024.0}
+        assert per_rank[1]["per_step"] == 1024.0
+        coll = comms["collectives"]["comms.reduce_scatter"]
+        assert coll["overall"]["count"] == 4
+        assert coll["ranks"][0]["p50"] == 0.002
+        # Non-comms phases stay out of the collectives table.
+        assert "train.step" not in comms["collectives"]
+
+    def test_markdown_section(self, comms_dir):
+        md = aggregate.render_markdown(aggregate.merge_gang_dir(comms_dir))
+        assert "## Comms" in md
+        assert "| comms.bytes_reduce_scattered | 0 | 4096 | 4 | 1024.0 |" in md
+        assert "| comms.reduce_scatter | all | 4 |" in md
+
+    def test_section_absent_without_comms_events(self, two_rank_dir):
+        report = aggregate.merge_gang_dir(two_rank_dir)
+        assert report["comms"] == {"counters": {}, "collectives": {}}
+        assert "## Comms" not in aggregate.render_markdown(report)
+
+
 class TestReportCLI:
     """tools/telemetry_report.py against the synthetic 2-rank fixture."""
 

@@ -1,0 +1,367 @@
+#!/usr/bin/env python
+"""Comms bench: DP update mode × bucket size × comms dtype, CPU mesh.
+
+Sweeps the data-parallel update path on the virtual 8-device CPU mesh
+(the same fake cluster the test suite uses):
+
+- ``replicated`` — ``make_data_parallel_step`` (full-gradient allreduce,
+  replicated optimizer state);
+- ``zero1`` — ``parallel.zero.make_zero1_step`` (bucketed reduce-scatter
+  → 1/N sharded update → allgather) across bucket sizes and comms dtypes
+  (fp32 / bf16 / int8-with-per-bucket-scale).
+
+Besides the throughput sweep it records the PR's acceptance evidence:
+the ZeRO-1 trajectory-equivalence check against the replicated step
+(bit-identity for fp32 comms, max-abs-diff for the lossy dtypes) and the
+per-chip optimizer-state-bytes ratio (≈ 1/N of replicated). Collective
+phases run standalone under ``comms.reduce_scatter``/``comms.allgather``
+telemetry spans so the artifact (and any merged gang report) carries
+their p50/p99.
+
+Writes one JSON artifact (``--out``, default stdout). ``--smoke`` is the
+tier-1 CI configuration: a 2-point sweep with tiny step counts, seconds
+on CPU. CPU collective *times* say nothing about ICI — the artifact is
+about semantics (equivalence, memory) and relative wire-byte accounting;
+the mode × bucket × dtype surface transfers to TPU, the absolute
+numbers do not.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# The virtual 8-device CPU mesh must be requested BEFORE jax import
+# (tests/conftest.py contract).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from machine_learning_apache_spark_tpu import telemetry  # noqa: E402
+from machine_learning_apache_spark_tpu.models import MLP  # noqa: E402
+from machine_learning_apache_spark_tpu.parallel import (  # noqa: E402
+    DATA_AXIS,
+    make_mesh,
+)
+from machine_learning_apache_spark_tpu.parallel import zero  # noqa: E402
+from machine_learning_apache_spark_tpu.parallel.data_parallel import (  # noqa: E402
+    make_data_parallel_step,
+)
+from machine_learning_apache_spark_tpu.parallel.mesh import shard_batch  # noqa: E402
+from machine_learning_apache_spark_tpu.telemetry import aggregate  # noqa: E402
+from machine_learning_apache_spark_tpu.train.state import (  # noqa: E402
+    TrainState,
+    make_optimizer,
+)
+from machine_learning_apache_spark_tpu.utils.jax_compat import (  # noqa: E402
+    shard_map,
+)
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+WIDTH = 256  # ~100k params with the in/out stems: enough for real buckets
+
+
+def _workload():
+    """Deterministic regression workload: MLP(64→256→256→64), fixed
+    batches. Everything derives from fixed seeds so every mode sees the
+    identical trajectory inputs."""
+    model = MLP(layers=(64, WIDTH, WIDTH, 64))
+    params0 = model.init(jax.random.key(0), jnp.ones((8, 64)))["params"]
+
+    def loss_fn(params, batch, rng):
+        del rng
+        x, y = batch
+        out = model.apply({"params": params}, x)
+        loss = jnp.mean((out - y) ** 2)
+        return loss, {}
+
+    gen = np.random.default_rng(1234)
+
+    def batch_at(i):
+        del i  # the generator stream orders them
+        x = jnp.asarray(gen.normal(size=(64, 64)), jnp.float32)
+        y = jnp.asarray(gen.normal(size=(64, 64)), jnp.float32)
+        return x, y
+
+    return model, params0, loss_fn, batch_at
+
+
+def _fresh_state(model, params0, tx):
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=jax.tree.map(jnp.copy, params0),
+        tx=tx,
+    )
+
+
+def _run_replicated(mesh, model, params0, loss_fn, tx, batches, rngs):
+    step = make_data_parallel_step(loss_fn, mesh)
+    state = _fresh_state(model, params0, tx)
+    for b, r in zip(batches, rngs):
+        state, loss, _ = step(state, shard_batch(mesh, b), r)
+    jax.block_until_ready(state.params)
+    return state
+
+
+def _run_zero1(mesh, model, params0, loss_fn, tx, batches, rngs, config):
+    state = zero.init_sharded(
+        apply_fn=model.apply,
+        params=jax.tree.map(jnp.copy, params0),
+        tx=tx,
+        mesh=mesh,
+        config=config,
+    )
+    step = zero.make_zero1_step(loss_fn, mesh, state)
+    for b, r in zip(batches, rngs):
+        state, loss, _ = step(state, shard_batch(mesh, b), r)
+    jax.block_until_ready(state.params)
+    return state, step
+
+
+def _max_diff(a, b) -> float:
+    return max(
+        jax.tree.leaves(
+            jax.tree.map(
+                lambda x, y: float(
+                    np.max(np.abs(np.asarray(x) - np.asarray(y)))
+                ),
+                a, b,
+            )
+        )
+    )
+
+
+def equivalence_check(mesh, steps: int, dtypes=zero.COMMS_DTYPES) -> dict:
+    """N-step trajectory parity: zero1(fp32) must be bit-identical to the
+    replicated step; bf16/int8 report their drift. Plus the per-chip
+    optimizer-memory ratio the ZeRO-1 rewrite exists for. ``dtypes`` must
+    include float32 (the gate); smoke passes just that one."""
+    model, params0, loss_fn, batch_at = _workload()
+    tx = make_optimizer("adam", 1e-2)
+    batches = [batch_at(i) for i in range(steps)]
+    rngs = [jax.random.fold_in(jax.random.key(7), i) for i in range(steps)]
+
+    rep = _run_replicated(mesh, model, params0, loss_fn, tx, batches, rngs)
+    rep_params = jax.device_get(rep.params)
+    replicated_bytes = zero.opt_state_bytes(rep.opt_state)
+
+    n = mesh.shape[DATA_AXIS]
+    out: dict = {"steps": steps, "n_devices": int(n)}
+    per_chip = None
+    for dtype in dtypes:
+        cfg = zero.Zero1Config(bucket_bytes=65536, comms_dtype=dtype)
+        z, _ = _run_zero1(
+            mesh, model, params0, loss_fn, tx, batches, rngs, cfg
+        )
+        diff = _max_diff(rep_params, jax.device_get(z.params))
+        out[f"max_abs_diff_{dtype}"] = diff
+        if dtype == "float32":
+            out["bit_identical_float32"] = diff == 0.0
+            per_chip = zero.opt_state_bytes_per_chip(z)
+    ratio = per_chip / replicated_bytes
+    bound = 1.0 / n + 0.01  # ε: pad tail + replicated step-count scalars
+    out.update(
+        opt_state_bytes_per_chip=per_chip,
+        replicated_opt_state_bytes=replicated_bytes,
+        opt_state_ratio=round(ratio, 5),
+        opt_state_bound=round(bound, 5),
+        opt_state_ok=ratio <= bound,
+    )
+    out["ok"] = bool(out["bit_identical_float32"] and out["opt_state_ok"])
+    return out
+
+
+def bench_point(mesh, mode: str, steps: int, config=None) -> dict:
+    """One sweep point: steps/sec of the fused step after warmup."""
+    model, params0, loss_fn, batch_at = _workload()
+    tx = make_optimizer("adam", 1e-2)
+    batch = shard_batch(mesh, batch_at(0))
+    rng = jax.random.key(3)
+    point = {"mode": mode}
+    if mode == "replicated":
+        step = make_data_parallel_step(loss_fn, mesh)
+        state = _fresh_state(model, params0, tx)
+    else:
+        state = zero.init_sharded(
+            apply_fn=model.apply,
+            params=jax.tree.map(jnp.copy, params0),
+            tx=tx,
+            mesh=mesh,
+            config=config,
+        )
+        step = zero.make_zero1_step(loss_fn, mesh, state)
+        point.update(
+            bucket_bytes=config.bucket_bytes,
+            comms_dtype=config.comms_dtype,
+            opt_state_bytes_per_chip=zero.opt_state_bytes_per_chip(state),
+            **{
+                k: step.comms_stats[k]
+                for k in ("reduce_scatter_bytes", "allgather_bytes", "n_buckets")
+            },
+        )
+    for _ in range(2):  # compile + settle
+        state, loss, _ = step(state, batch, rng)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss, _ = step(state, batch, rng)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    point.update(
+        steps=steps,
+        steps_per_sec=round(steps / dt, 2),
+        step_ms=round(dt / steps * 1e3, 3),
+        loss=round(float(loss), 4),
+    )
+    return point
+
+
+def bench_collectives(mesh, config, reps: int) -> None:
+    """Standalone reduce-scatter / allgather timings under telemetry spans
+    — inside the fused step XLA overlaps them with compute, so the span
+    p50/p99 the report wants has to come from separately-jitted phases."""
+    axis = config.axis
+    n = mesh.shape[axis]
+    model, params0, _, _ = _workload()
+    plan = zero.make_flat_plan(params0, n, config.bucket_bytes)
+
+    def rs_shard(flat):
+        pieces = [
+            zero._reduce_scatter_bucket(
+                flat[s:e], axis, n, config.comms_dtype
+            )
+            for s, e in plan.buckets
+        ]
+        return jnp.concatenate(pieces)
+
+    def ag_shard(shard):
+        segments, offset = [], 0
+        for s, e in plan.buckets:
+            piece_len = (e - s) // n
+            segments.append(
+                jax.lax.all_gather(
+                    shard[offset:offset + piece_len], axis, tiled=True
+                )
+            )
+            offset += piece_len
+        return jnp.concatenate(segments)
+
+    rs = jax.jit(shard_map(
+        rs_shard, mesh=mesh, in_specs=(P(),), out_specs=P(axis)
+    ))
+    ag = jax.jit(shard_map(
+        ag_shard, mesh=mesh, in_specs=(P(axis),), out_specs=P()
+    ))
+    flat = jnp.ones((plan.padded,), jnp.float32)
+    shard = jax.block_until_ready(rs(flat))  # also compiles
+    jax.block_until_ready(ag(shard))
+    attrs = {
+        "bucket_bytes": config.bucket_bytes,
+        "comms_dtype": config.comms_dtype,
+        "n_buckets": len(plan.buckets),
+    }
+    for _ in range(reps):
+        with telemetry.span("comms.reduce_scatter", **attrs):
+            jax.block_until_ready(rs(flat))
+        with telemetry.span("comms.allgather", **attrs):
+            jax.block_until_ready(ag(shard))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--out", default=None, help="artifact path (default stdout)")
+    ap.add_argument("--steps", type=int, default=20, help="timed steps/point")
+    ap.add_argument(
+        "--equiv-steps", type=int, default=8,
+        help="trajectory length for the equivalence check",
+    )
+    ap.add_argument(
+        "--reps", type=int, default=10,
+        help="standalone collective repetitions (span p50/p99 sample size)",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="tier-1 CI config: 2-point sweep, tiny step counts",
+    )
+    ns = ap.parse_args(argv)
+    if ns.smoke:
+        ns.steps, ns.equiv_steps, ns.reps = 3, 3, 3
+
+    n = jax.device_count()
+    artifact: dict = {
+        "artifact": "comms_bench",
+        "n_devices": n,
+        "platform": jax.devices()[0].platform,
+        "smoke": bool(ns.smoke),
+    }
+    if n < 2:
+        artifact.update(ok=False, error=f"need >=2 devices, got {n}")
+        _write(artifact, ns.out)
+        return 1
+
+    mesh = make_mesh({DATA_AXIS: n})
+    artifact["equivalence"] = equivalence_check(
+        mesh, ns.equiv_steps,
+        dtypes=("float32",) if ns.smoke else zero.COMMS_DTYPES,
+    )
+
+    if ns.smoke:
+        zero1_cfgs = [zero.Zero1Config()]
+    else:
+        zero1_cfgs = [
+            zero.Zero1Config(bucket_bytes=bb, comms_dtype=dt)
+            for bb in (65536, zero.DEFAULT_BUCKET_BYTES)
+            for dt in zero.COMMS_DTYPES
+        ]
+    sweep = [bench_point(mesh, "replicated", ns.steps)]
+    for cfg in zero1_cfgs:
+        sweep.append(bench_point(mesh, "zero1", ns.steps, cfg))
+        bench_collectives(mesh, cfg, ns.reps)
+    artifact["sweep"] = sweep
+
+    # Fold this process's comms.* spans into the same rollup shape the
+    # gang report uses (telemetry_report.py "Comms" section).
+    events = [ev.to_dict() for ev in telemetry.get_log().snapshot()]
+    artifact["comms"] = aggregate.comms_report(events)
+    tdir = telemetry.telemetry_dir()
+    if tdir:
+        telemetry.write_rank_file(tdir)
+
+    artifact["ok"] = bool(
+        artifact["equivalence"]["ok"]
+        and all("steps_per_sec" in p for p in sweep)
+    )
+    _write(artifact, ns.out)
+    return 0 if artifact["ok"] else 1
+
+
+def _write(artifact: dict, out: str | None) -> None:
+    text = json.dumps(artifact, indent=2) + "\n"
+    if out:
+        with open(out, "w") as f:
+            f.write(text)
+        print(
+            f"comms_bench: ok={artifact.get('ok')} -> {out}", file=sys.stderr
+        )
+    else:
+        print(text, end="")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,9 @@
 The offline half of ``telemetry.aggregate``: point it at a directory of
 ``telemetry_rank<k>.jsonl`` files (a gang workdir, or wherever
 ``MLSPARK_TELEMETRY_DIR`` pointed) and get the gang-wide per-phase
-p50/p99 table plus the rank-skew (straggler attribution) report.
+p50/p99 table, the rank-skew (straggler attribution) report, and a comms
+section (zero1 wire bytes per step, collective span p50/p99) when the
+run recorded any ``comms.*`` events.
 
 Usage::
 
@@ -53,6 +55,7 @@ def _report_from_files(paths: list[str]) -> dict:
         "event_count": len(events),
         "phases": table,
         "skew": aggregate.skew_report(table),
+        "comms": aggregate.comms_report(events, table),
     }
 
 
