@@ -1,0 +1,50 @@
+"""ingest/ — async streaming input pipeline (the Spark-ingestion layer).
+
+The reference's premise is Spark feeding accelerator training; this
+subsystem is that layer rebuilt TPU-native: sharded streaming readers
+(``readers``, native C++ parsers with pure-Python fallbacks), online
+sequence packing in the loader thread (``packing``), weighted
+deterministic mixture sampling (``mixture``), and the bounded
+prefetch-to-device pipeline that ties them together (``pipeline``) —
+``fit(data=StreamingPipeline(...))`` trains with batch k+1 device-resident
+before step k's dispatch returns.
+
+Env contract: ``MLSPARK_INGEST_*`` (``config``), plumbed through the
+launcher via ``Distributor(ingest={...})``. Telemetry: the ``data.*``
+span/counter family; ``tools/telemetry_report.py`` renders it and
+classifies runs input-bound vs compute-bound. See docs/DATA.md.
+"""
+
+from machine_learning_apache_spark_tpu.ingest.config import (
+    IngestConfig,
+    validate_ingest_knobs,
+)
+from machine_learning_apache_spark_tpu.ingest.mixture import MixtureSampler
+from machine_learning_apache_spark_tpu.ingest.packing import OnlinePacker
+from machine_learning_apache_spark_tpu.ingest.pipeline import (
+    StreamingPipeline,
+    WORKER_PREFIX,
+)
+from machine_learning_apache_spark_tpu.ingest.readers import (
+    ArraySource,
+    CallableSource,
+    EncodedTextSource,
+    LibsvmStreamSource,
+    PairSource,
+    TextLineSource,
+)
+
+__all__ = [
+    "ArraySource",
+    "CallableSource",
+    "EncodedTextSource",
+    "IngestConfig",
+    "LibsvmStreamSource",
+    "MixtureSampler",
+    "OnlinePacker",
+    "PairSource",
+    "StreamingPipeline",
+    "TextLineSource",
+    "WORKER_PREFIX",
+    "validate_ingest_knobs",
+]

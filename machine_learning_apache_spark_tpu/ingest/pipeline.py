@@ -1,0 +1,585 @@
+"""StreamingPipeline — records to device-resident batches, off the hot path.
+
+The stage chain (each optional stage collapses to a pass-through):
+
+    source -> [transform] -> [online pack] -> shard -> batch/tail-policy
+           -> [bounded host prefetch thread] -> [double-buffered device put]
+
+Everything left of the prefetch queue runs on a background producer
+thread; the consumer (the training loop) pulls host batches from a
+bounded queue and enqueues ``jax.device_put`` (or the mesh-sharded
+``shard_batch``) ``device_prefetch`` batches ahead, so batch k+1 is
+device-resident before step k's async dispatch returns. The queue bound
+caps host memory; shutdown is clean — ``shutdown()`` (called by ``fit``'s
+finally) releases the producer and joins it, leaving no threads behind.
+
+**Batch-count equalization** (the gang-deadlock fix): every rank MUST
+yield the same number of batches per epoch or the epoch-tail collective
+hangs. Two shard modes, two guarantees:
+
+- ``shard="records"`` (default): every rank enumerates the same global
+  unit stream (records, or packed rows when packing is on) and keeps
+  units ``i % world == rank``. Per-rank counts differ by at most one and
+  every rank knows the global count N at end of stream, so the tail
+  policy is computed from N identically everywhere: ``tail="pad"`` wraps
+  each rank's own recent units to ``ceil(ceil(N/world)/B)`` batches
+  (the ``DistributedSampler`` convention); ``tail="drop"`` truncates every
+  rank to ``(N // world) // B`` (a one-batch holdback keeps a rank with a
+  surplus unit from over-yielding before N is known).
+- ``shard="files"``: rank r reads only ``paths[r::world]`` (a true I/O
+  split; per-rank record counts are ragged and no rank knows N), so a
+  fixed ``steps_per_epoch`` is REQUIRED for world > 1: every rank yields
+  exactly that many batches, wrapping its local stream when short.
+
+Record-level sharding duplicates read/parse work across ranks in
+exchange for the guarantee and for global-stream determinism (mixture
+sampling needs every rank to see the same draw sequence); file-level
+sharding is the scalable path when the file set is large. See
+docs/DATA.md for the decision table.
+
+Telemetry: every stage reports into the ``data.*`` family —
+``data.read`` / ``data.pack`` / ``data.h2d`` phase durations (per batch),
+``data.wait`` (consumer time blocked on the host buffer — the direct
+input-bound signal), a ``data.buffer_occupancy`` gauge sampled at every
+producer put, and per-epoch ``data.records`` / ``data.batches`` /
+``data.bytes_h2d`` counters. ``telemetry.aggregate.ingest_report`` folds
+these into the gang report's input-bound/compute-bound verdict.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue as _queue
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from machine_learning_apache_spark_tpu import telemetry
+from machine_learning_apache_spark_tpu.ingest.config import IngestConfig
+from machine_learning_apache_spark_tpu.ingest.packing import OnlinePacker
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: Thread-name prefix for every pipeline worker — the leak check in
+#: tests (and operators' py-spy dumps) find them by this.
+WORKER_PREFIX = "mlspark-ingest"
+
+_END, _ERR = object(), object()
+
+SHARD_MODES = ("records", "files")
+
+_PACK_KEYS = {"src_len", "trg_len", "pad_id", "max_segments"}
+
+
+def _default_collate(units: list) -> Any:
+    """Stack per-field: a list of B record tuples becomes a tuple of
+    ``[B, ...]`` arrays (scalar fields stack to ``[B]`` vectors)."""
+    first = units[0]
+    if isinstance(first, tuple):
+        return tuple(
+            np.stack([u[i] for u in units]) for i in range(len(first))
+        )
+    return np.stack(units)
+
+
+def _env_int(name: str, default: int) -> int:
+    import os
+
+    v = os.environ.get(name)
+    try:
+        return int(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def _emit_phase(name: str, seconds: float, **attrs) -> None:
+    """Record a phase duration as a ``span_end`` event so the aggregate
+    phase table picks it up. Producer-side phases are accumulated per
+    batch (per-record spans would flood the bounded event ring)."""
+    telemetry.get_log().emit(
+        "span_end", name, value=seconds, attrs=attrs or None
+    )
+
+
+class _UnitStream:
+    """One pass over the pipeline's global unit stream: applies transform
+    and online packing, filters to this rank's units (records mode), and
+    accumulates read/pack time for the per-batch phase events. After
+    exhaustion, ``global_units`` holds the pass's total unit count (global
+    in records mode, local in files mode) and ``records_read`` the number
+    of records pulled from the source."""
+
+    def __init__(self, pipeline: "StreamingPipeline") -> None:
+        self.pl = pipeline
+        self.read_seconds = 0.0
+        self.pack_seconds = 0.0
+        self.records_read = 0
+        self.global_units = 0
+
+    def __iter__(self) -> Iterator:
+        pl = self.pl
+        perf = time.perf_counter
+        filt = pl.shard == "records" and pl.world > 1
+        rank, world = pl.rank, pl.world
+        packer = OnlinePacker(**pl.pack) if pl.pack is not None else None
+        transform = pl.transform
+        idx = 0  # unit index within the (global) stream
+        it = iter(pl._source)
+        while True:
+            t0 = perf()
+            try:
+                rec = next(it)
+            except StopIteration:
+                self.read_seconds += perf() - t0
+                break
+            if transform is not None:
+                rec = transform(rec)
+            self.read_seconds += perf() - t0
+            self.records_read += 1
+            if packer is None:
+                if not filt or idx % world == rank:
+                    yield rec
+                idx += 1
+            else:
+                t1 = perf()
+                row = packer.add(rec[0], rec[1])
+                self.pack_seconds += perf() - t1
+                if row is not None:
+                    if not filt or idx % world == rank:
+                        yield row
+                    idx += 1
+        if packer is not None:
+            t1 = perf()
+            row = packer.flush()
+            self.pack_seconds += perf() - t1
+            if row is not None:
+                if not filt or idx % world == rank:
+                    yield row
+                idx += 1
+        self.global_units = idx
+
+
+class StreamingPipeline:
+    """Async streaming input pipeline; the ``data=`` argument of
+    ``train.loop.fit``.
+
+    - ``source``: any ``ingest.readers`` source, a ``MixtureSampler``, or
+      a plain restartable iterable of records.
+    - ``batch_size``: records (or packed rows) per batch — the static
+      leading dimension.
+    - ``rank``/``world``: gang coordinates; default from the launcher env
+      contract (``MLSPARK_PROCESS_ID`` / ``MLSPARK_NUM_PROCESSES``).
+    - ``shard``/``tail``/``steps_per_epoch``: see the module docstring's
+      equalization contract.
+    - ``transform``: per-record callable applied in the producer thread
+      (tokenize-outside-the-step seam).
+    - ``pack``: ``dict(src_len=, trg_len=, pad_id=, max_segments=)``
+      enables online packing; records must then be (src_ids, trg_ids)
+      pairs and batches are stacked 6-tuples of packed rows.
+    - ``buffer``/``device_prefetch``: queue depths, resolved through
+      ``MLSPARK_INGEST_*`` when not given (``IngestConfig.from_env``).
+    - ``mesh``/``device``: device placement — mesh-sharded when a mesh is
+      bound (``fit`` binds its own), plain ``jax.device_put`` otherwise;
+      ``device=False`` yields host batches.
+    """
+
+    #: duck-typing marker for fit() — avoids an import cycle.
+    is_streaming_pipeline = True
+
+    def __init__(
+        self,
+        source,
+        batch_size: int,
+        *,
+        rank: int | None = None,
+        world: int | None = None,
+        shard: str = "records",
+        tail: str | None = None,
+        steps_per_epoch: int | None = None,
+        transform: Callable | None = None,
+        collate: Callable[[list], Any] | None = None,
+        pack: dict | None = None,
+        buffer: int | None = None,
+        device_prefetch: int | None = None,
+        mesh=None,
+        device: bool = True,
+        name: str = "train",
+    ) -> None:
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        if shard not in SHARD_MODES:
+            raise ValueError(
+                f"unknown shard mode {shard!r} (expected one of {SHARD_MODES})"
+            )
+        if steps_per_epoch is not None and steps_per_epoch < 1:
+            raise ValueError(
+                f"steps_per_epoch must be >= 1, got {steps_per_epoch}"
+            )
+        self.config = IngestConfig.from_env(
+            buffer=buffer, device_prefetch=device_prefetch, tail=tail
+        )
+        self.batch_size = batch_size
+        self.rank = rank if rank is not None else _env_int(
+            "MLSPARK_PROCESS_ID", 0
+        )
+        self.world = world if world is not None else _env_int(
+            "MLSPARK_NUM_PROCESSES", 1
+        )
+        if not 0 <= self.rank < self.world:
+            raise ValueError(
+                f"rank {self.rank} outside world of {self.world}"
+            )
+        self.shard = shard
+        self.steps_per_epoch = steps_per_epoch
+        self.transform = transform
+        self.collate = collate or _default_collate
+        if pack is not None:
+            unknown = set(pack) - _PACK_KEYS
+            if unknown:
+                raise ValueError(
+                    f"unknown pack option(s) {sorted(unknown)} "
+                    f"(expected a subset of {sorted(_PACK_KEYS)})"
+                )
+            OnlinePacker(**pack)  # validate budgets now, not mid-epoch
+        self.pack = dict(pack) if pack is not None else None
+        self.mesh = mesh
+        self.device = device
+        self.name = name
+        if shard == "files":
+            if not hasattr(source, "shard_files"):
+                raise ValueError(
+                    f"shard='files' needs a file-backed source with "
+                    f"shard_files(); {type(source).__name__} has none — "
+                    "use shard='records'"
+                )
+            if self.world > 1:
+                if steps_per_epoch is None:
+                    raise ValueError(
+                        "shard='files' with world > 1 requires "
+                        "steps_per_epoch: ranks read disjoint files, so no "
+                        "rank knows the global record count and only a "
+                        "fixed per-epoch step budget keeps batch counts "
+                        "equal across the gang (gang collectives deadlock "
+                        "otherwise)"
+                    )
+                source = source.shard_files(self.rank, self.world)
+        self._source = source
+        self._epoch = 0
+        self._workers: list[tuple[threading.Event, threading.Thread, Any]] = []
+        #: batches yielded in the most recently completed epoch.
+        self.last_epoch_batches: int | None = None
+
+    # -- epoch / fit integration --------------------------------------------
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+        if hasattr(self._source, "set_epoch"):
+            self._source.set_epoch(epoch)
+
+    def bind(self, *, mesh=None, device: bool | None = None) -> None:
+        """Late-bind device placement (``fit`` passes its mesh here; the
+        scanned ``steps_per_call`` path binds ``device=False`` because it
+        stacks host batches itself)."""
+        if mesh is not None:
+            self.mesh = mesh
+        if device is not None:
+            self.device = device
+
+    @property
+    def yields_device_batches(self) -> bool:
+        return self.device and self.config.device_prefetch > 0
+
+    # -- resume state --------------------------------------------------------
+    def state_dict(self) -> dict:
+        """JSON-safe pipeline position for the checkpoint meta sidecar:
+        the epoch counter plus the source's stream state (mixture RNG and
+        cursors) when the source is stateful."""
+        sd: dict = {"version": 1, "epoch": self._epoch}
+        if hasattr(self._source, "state_dict"):
+            sd["source"] = self._source.state_dict()
+        return sd
+
+    def load_state_dict(self, sd: dict) -> None:
+        self._epoch = int(sd.get("epoch", 0))
+        src_state = sd.get("source")
+        if src_state is not None:
+            if not hasattr(self._source, "load_state_dict"):
+                raise ValueError(
+                    "checkpoint carries ingest source state but "
+                    f"{type(self._source).__name__} cannot restore it — "
+                    "resuming would silently replay a different stream"
+                )
+            self._source.load_state_dict(src_state)
+
+    # -- iteration -----------------------------------------------------------
+    def __iter__(self) -> Iterator:
+        it = self._host_batches()
+        if self.config.buffer > 0:
+            it = self._prefetched(it)
+        if self.yields_device_batches:
+            it = self._device_stage(it)
+        return it
+
+    def _host_batches(self) -> Iterator:
+        B = self.batch_size
+        target = self.steps_per_epoch
+        tail = self.config.tail
+        epoch = self._epoch
+        eq_world = self.world if self.shard == "records" else 1
+        yielded = 0
+        pending = None  # drop-policy holdback (see module docstring)
+        buf: list = []
+        # Wrap-pad material: a rank's most recent units, enough to fill
+        # one batch — bounded, unlike retaining the shard.
+        recent: collections.deque = collections.deque(maxlen=B)
+        records_acc = 0
+        stream: _UnitStream | None = None
+
+        def _batch_of(units: list):
+            t0 = time.perf_counter()
+            out = self.collate(units)
+            if telemetry.enabled() and stream is not None:
+                _emit_phase(
+                    "data.read",
+                    stream.read_seconds + (time.perf_counter() - t0),
+                    epoch=epoch,
+                )
+                stream.read_seconds = 0.0
+                if self.pack is not None:
+                    _emit_phase("data.pack", stream.pack_seconds, epoch=epoch)
+                    stream.pack_seconds = 0.0
+            return out
+
+        try:
+            while True:  # >1 pass only when steps_per_epoch wraps the stream
+                stream = _UnitStream(self)
+                pass_units = 0
+                for unit in stream:
+                    pass_units += 1
+                    buf.append(unit)
+                    recent.append(unit)
+                    if len(buf) == B:
+                        batch = _batch_of(buf)
+                        buf = []
+                        if target is None and tail == "drop":
+                            if pending is not None:
+                                yield pending
+                                yielded += 1
+                            pending = batch
+                        else:
+                            yield batch
+                            yielded += 1
+                            if target is not None and yielded >= target:
+                                return
+                records_acc += stream.records_read
+                stream.records_read = 0  # folded; finally must not re-add
+                if target is None:
+                    break
+                if pass_units == 0:
+                    raise ValueError(
+                        f"ingest source yielded no units on a full pass; "
+                        f"cannot reach steps_per_epoch={target}"
+                    )
+                stream = None  # records already folded into records_acc
+            # Natural end of the stream: equalize the epoch tail from the
+            # unit count every rank observed identically.
+            n = stream.global_units
+            if tail == "drop":
+                allowed = (n // eq_world) // B
+                if pending is not None and yielded < allowed:
+                    yield pending
+                    yielded += 1
+                pending = None
+            else:  # pad
+                per_rank = -(-n // eq_world)  # ceil
+                target_pad = -(-per_rank // B)
+                fill = list(buf)
+                buf = []
+                ring = list(recent)
+                if yielded < target_pad and not ring:
+                    raise ValueError(
+                        f"rank {self.rank} saw no units this epoch but the "
+                        f"gang-wide batch target is {target_pad}; the "
+                        f"dataset ({n} unit(s)) is smaller than the world "
+                        f"size {eq_world}"
+                    )
+                i = 0
+                while yielded < target_pad:
+                    while len(fill) < B:
+                        fill.append(ring[i % len(ring)])
+                        i += 1
+                    yield _batch_of(fill[:B])
+                    fill = fill[B:]
+                    yielded += 1
+        finally:
+            if stream is not None:
+                records_acc += stream.records_read
+            self.last_epoch_batches = yielded
+            reg = telemetry.get_registry()
+            reg.counter("data", "records").inc(records_acc)
+            reg.counter("data", "batches").inc(yielded)
+            if telemetry.enabled():
+                log_ = telemetry.get_log()
+                log_.emit(
+                    "counter", "data.records", value=float(records_acc),
+                    attrs={"epoch": epoch},
+                )
+                log_.emit(
+                    "counter", "data.batches", value=float(yielded),
+                    attrs={"epoch": epoch},
+                )
+
+    def _prefetched(self, it: Iterator) -> Iterator:
+        """Bounded producer/consumer stage: batch assembly moves to a
+        background thread; the queue bound caps host memory. Same
+        stop-event/sentinel shutdown discipline as ``data.loader``'s
+        prefetcher, plus occupancy telemetry and a join on teardown (no
+        leaked threads — pinned by tests/test_ingest.py)."""
+        q: _queue.Queue = _queue.Queue(maxsize=self.config.buffer)
+        stop = threading.Event()
+        gauge = telemetry.get_registry().gauge("data", "buffer_occupancy")
+
+        def _put(item) -> bool:
+            # Bounded-wait put: an abandoned consumer releases the worker
+            # within 100ms of shutdown() setting the stop event.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for item in it:
+                    if not _put(item):
+                        return
+                    occ = q.qsize()
+                    gauge.set(occ)
+                    if telemetry.enabled():
+                        telemetry.get_log().emit(
+                            "gauge", "data.buffer_occupancy", value=float(occ)
+                        )
+            except BaseException as e:  # re-raised at the consumer
+                _put((_ERR, e))
+            else:
+                _put(_END)
+
+        thread = threading.Thread(
+            target=worker,
+            daemon=True,
+            name=f"{WORKER_PREFIX}-{self.name}-e{self._epoch}",
+        )
+        handle = (stop, thread, q)
+        self._workers.append(handle)
+        thread.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                while True:
+                    try:
+                        item = q.get(timeout=1.0)
+                        break
+                    except _queue.Empty:
+                        if not thread.is_alive():
+                            raise RuntimeError(
+                                "ingest producer thread died without a "
+                                "sentinel (killed?)"
+                            ) from None
+                wait = time.perf_counter() - t0
+                if item is _END:
+                    return
+                if (
+                    isinstance(item, tuple)
+                    and len(item) == 2
+                    and item[0] is _ERR
+                ):
+                    raise item[1]
+                if telemetry.enabled():
+                    _emit_phase("data.wait", wait, epoch=self._epoch)
+                yield item
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except _queue.Empty:
+                pass
+            thread.join(timeout=5.0)
+            if handle in self._workers:
+                self._workers.remove(handle)
+
+    def _device_stage(self, it: Iterator) -> Iterator:
+        """Double-buffered device placement: enqueue ``device_prefetch``
+        transfers ahead of consumption, so H2D overlaps device compute
+        (transfers are async; the span measures enqueue cost)."""
+        import jax
+
+        from machine_learning_apache_spark_tpu.parallel.mesh import (
+            shard_batch,
+        )
+
+        depth = max(self.config.device_prefetch, 1)
+        pending: collections.deque = collections.deque()
+        h2d_counter = telemetry.get_registry().counter("data", "bytes_h2d")
+        bytes_total = 0
+        try:
+            for batch in it:
+                nbytes = sum(
+                    x.nbytes
+                    for x in jax.tree.leaves(batch)
+                    if hasattr(x, "nbytes")
+                )
+                t0 = time.perf_counter()
+                dev = (
+                    shard_batch(self.mesh, batch)
+                    if self.mesh is not None
+                    else jax.device_put(batch)
+                )
+                if telemetry.enabled():
+                    _emit_phase(
+                        "data.h2d", time.perf_counter() - t0,
+                        epoch=self._epoch,
+                    )
+                h2d_counter.inc(nbytes)
+                bytes_total += nbytes
+                pending.append(dev)
+                if len(pending) >= depth:
+                    yield pending.popleft()
+            while pending:
+                yield pending.popleft()
+        finally:
+            if telemetry.enabled() and bytes_total:
+                telemetry.get_log().emit(
+                    "counter", "data.bytes_h2d", value=float(bytes_total),
+                    attrs={"epoch": self._epoch},
+                )
+
+    # -- teardown ------------------------------------------------------------
+    def shutdown(self) -> None:
+        """Release and join every live producer thread (idempotent; safe
+        mid-epoch). ``fit`` calls this in its finally, so a training run
+        leaves no pipeline threads behind whether it returned or raised."""
+        handles, self._workers = self._workers, []
+        for stop, _, _ in handles:
+            stop.set()
+        for _, thread, q in handles:
+            try:
+                while True:
+                    q.get_nowait()
+            except _queue.Empty:
+                pass
+            thread.join(timeout=5.0)
+            if thread.is_alive():
+                log.warning(
+                    "ingest worker %s did not exit within 5s", thread.name
+                )
+
+    def __enter__(self) -> "StreamingPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

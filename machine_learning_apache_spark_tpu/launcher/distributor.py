@@ -150,6 +150,7 @@ class Distributor:
         platform: str | None = None,
         env: dict[str, str] | None = None,
         dp_mode: str | None = None,
+        ingest: dict | None = None,
         timeout: float = 600.0,
         max_restarts: int = 0,
         heartbeat_interval: float = 1.0,
@@ -174,6 +175,20 @@ class Distributor:
                 "'zero1')"
             )
         self.dp_mode = dp_mode
+        # Input-pipeline plumbing, same shape as dp_mode: the
+        # Distributor(ingest={"buffer": 4, "tail": "pad", ...}) knob
+        # becomes MLSPARK_INGEST_* in every worker's environment (the
+        # contract ingest.IngestConfig.from_env resolves), validated at
+        # construction so a typo'd knob fails in the driver, not inside
+        # every rank after rendezvous.
+        if ingest:
+            from machine_learning_apache_spark_tpu.ingest.config import (
+                validate_ingest_knobs,
+            )
+
+            self.ingest_env = validate_ingest_knobs(ingest)
+        else:
+            self.ingest_env = {}
         self.timeout = timeout
         # Spark-barrier recovery semantics (SURVEY.md §5 failure detection):
         # a failed stage is retried whole — all-or-nothing gang restarts.
@@ -358,6 +373,9 @@ class Distributor:
             # dict(os.environ) above, and explicit env= still wins below.
             if self.dp_mode is not None:
                 env["MLSPARK_DP_MODE"] = self.dp_mode
+            # Ingest knobs ride the same contract: constructor > inherited
+            # env (explicit env= still wins below).
+            env.update(self.ingest_env)
             env.update(self.extra_env)
             # Workers default their telemetry output (rank JSONLs, flight
             # dumps) next to the heartbeat files; an inherited or explicit

@@ -191,6 +191,91 @@ def comms_report(events: list[dict], table: dict | None = None) -> dict:
     }
 
 
+#: Stall fraction above which a run is called input-bound: more than this
+#: share of (step + data-wait) time spent waiting on the input pipeline.
+INPUT_BOUND_THRESHOLD = 0.1
+
+
+def ingest_report(events: list[dict], table: dict | None = None) -> dict:
+    """Input-pipeline rollup for the gang report, from the ``data.*``
+    event family the ingest subsystem emits:
+
+    - ``phases``: the ``data.*`` rows of the phase table (read/pack/h2d
+      stage durations plus ``data.wait``, the consumer's time blocked on
+      the host prefetch buffer);
+    - ``buffer_occupancy``: per-rank stats over the
+      ``data.buffer_occupancy`` gauge (sampled at every producer put —
+      a buffer pinned at 0 means the producer can't keep up, pinned at
+      capacity means the device is the bottleneck);
+    - ``counters``: per-rank totals of the ``data.*`` counter events
+      (records/batches per epoch, H2D bytes);
+    - ``stall_fraction`` / ``verdict``: the input-bound vs compute-bound
+      classification — stall time (``data.wait``, or ``data.read`` for an
+      unbuffered pipeline, which then blocks the step loop directly) as a
+      fraction of stall + ``train.step`` time, input-bound above
+      ``INPUT_BOUND_THRESHOLD``.
+
+    Empty sub-dicts when the run had no ingest activity — the renderer
+    then omits the section.
+    """
+    table = phase_table(events) if table is None else table
+    occupancy: dict[int | None, list[float]] = {}
+    counters: dict[str, dict] = {}
+    for ev in events:
+        name = str(ev.get("name", ""))
+        if not name.startswith("data."):
+            continue
+        if ev.get("kind") == "gauge" and name == "data.buffer_occupancy":
+            occupancy.setdefault(ev.get("rank"), []).append(
+                float(ev.get("value") or 0.0)
+            )
+        elif ev.get("kind") == "counter":
+            per_rank = counters.setdefault(name, {})
+            entry = per_rank.setdefault(ev.get("rank"), {"total": 0.0})
+            entry["total"] += float(ev.get("value") or 0.0)
+    phases = {
+        phase: entry
+        for phase, entry in table.items()
+        if phase.startswith("data.")
+    }
+
+    def _total(phase: str) -> float:
+        entry = table.get(phase)
+        if not entry:
+            return 0.0
+        return entry["overall"]["mean"] * entry["overall"]["count"]
+
+    stall = _total("data.wait") or _total("data.read")
+    step = _total("train.step") + _total("train.step_group")
+    stall_fraction = (
+        round(stall / (stall + step), 4) if (stall + step) > 0 else None
+    )
+    verdict = None
+    if stall_fraction is not None and step > 0:
+        verdict = (
+            "input-bound"
+            if stall_fraction > INPUT_BOUND_THRESHOLD
+            else "compute-bound"
+        )
+    return {
+        "phases": phases,
+        "buffer_occupancy": {
+            rank: _stats(vals)
+            for rank, vals in sorted(
+                occupancy.items(), key=lambda kv: (kv[0] is None, kv[0])
+            )
+        },
+        "counters": {
+            name: dict(sorted(
+                per_rank.items(), key=lambda kv: (kv[0] is None, kv[0])
+            ))
+            for name, per_rank in sorted(counters.items())
+        },
+        "stall_fraction": stall_fraction,
+        "verdict": verdict,
+    }
+
+
 def merge_gang_dir(directory: str) -> dict:
     """One-call report over a gang workdir: find rank files, merge, build
     the phase table, skew report, and comms rollup."""
@@ -205,6 +290,7 @@ def merge_gang_dir(directory: str) -> dict:
         "phases": table,
         "skew": skew_report(table),
         "comms": comms_report(events, table),
+        "ingest": ingest_report(events, table),
     }
 
 
@@ -281,12 +367,63 @@ def render_markdown(report: dict) -> str:
                         f"| {phase} | {rank} | {s['count']} | {_fmt(s['mean'])} "
                         f"| {_fmt(s['p50'])} | {_fmt(s['p99'])} |"
                     )
+    ingest = report.get("ingest") or {}
+    if (
+        ingest.get("phases")
+        or ingest.get("buffer_occupancy")
+        or ingest.get("counters")
+    ):
+        lines += ["", "## Ingest (data.*)", ""]
+        if ingest.get("verdict"):
+            lines.append(
+                f"- verdict: **{ingest['verdict']}** "
+                f"(stall fraction {ingest['stall_fraction']})"
+            )
+            lines.append("")
+        if ingest.get("phases"):
+            lines.append("| stage | rank | count | mean | p50 | p99 | max |")
+            lines.append("|---|---|---|---|---|---|---|")
+            for phase, entry in ingest["phases"].items():
+                o = entry["overall"]
+                lines.append(
+                    f"| {phase} | all | {o['count']} | {_fmt(o['mean'])} "
+                    f"| {_fmt(o['p50'])} | {_fmt(o['p99'])} | {_fmt(o['max'])} |"
+                )
+                for rank, s in entry["ranks"].items():
+                    lines.append(
+                        f"| {phase} | {rank} | {s['count']} | {_fmt(s['mean'])} "
+                        f"| {_fmt(s['p50'])} | {_fmt(s['p99'])} | {_fmt(s['max'])} |"
+                    )
+        if ingest.get("buffer_occupancy"):
+            lines.append("")
+            lines.append(
+                "| buffer occupancy | rank | samples | mean | p50 | p99 | max |"
+            )
+            lines.append("|---|---|---|---|---|---|---|")
+            for rank, s in ingest["buffer_occupancy"].items():
+                # Occupancies are batch counts, not durations — render raw.
+                lines.append(
+                    f"| data.buffer_occupancy | {rank} | {s['count']} "
+                    f"| {s['mean']:.2f} | {s['p50']:g} | {s['p99']:g} "
+                    f"| {s['max']:g} |"
+                )
+        if ingest.get("counters"):
+            lines.append("")
+            lines.append("| counter | rank | total |")
+            lines.append("|---|---|---|")
+            for name, per_rank in ingest["counters"].items():
+                for rank, entry in per_rank.items():
+                    lines.append(
+                        f"| {name} | {rank} | {int(entry['total'])} |"
+                    )
     return "\n".join(lines) + "\n"
 
 
 __all__ = [
+    "INPUT_BOUND_THRESHOLD",
     "comms_report",
     "find_rank_files",
+    "ingest_report",
     "load_jsonl",
     "merge_gang_dir",
     "merge_rank_files",

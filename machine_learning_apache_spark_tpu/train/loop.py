@@ -174,8 +174,9 @@ def _with_comms_counters(zstep, state):
 def fit(
     state: TrainState,
     loss_fn: LossFn,
-    train_loader: Iterable,
+    train_loader: Iterable | None = None,
     *,
+    data: Iterable | None = None,
     epochs: int,
     rng: jax.Array | None = None,
     mesh=None,
@@ -203,6 +204,18 @@ def fit(
     ``train_loader`` yields batch pytrees; if it has ``set_epoch``, it is
     called per epoch (the ``sampler.set_epoch`` contract,
     ``distributed_cnn.py:168``, with correct Q3 semantics).
+
+    ``data=`` is an alias for ``train_loader`` and the idiomatic spelling
+    for an ``ingest.StreamingPipeline``: fit binds its mesh to the
+    pipeline's device stage, consumes device-resident batches directly,
+    captures the pipeline's stream state (mixture RNG, cursors) in each
+    checkpoint's meta sidecar, restores it on ``resume=True`` so the
+    resumed run replays the identical batch sequence, and shuts the
+    pipeline's producer threads down when fit returns OR raises (no
+    leaked threads — docs/DATA.md). The scanned ``steps_per_call`` path
+    and fit's own ``prefetch_to_device`` stack/shard host batches
+    themselves, so with either of those the pipeline is bound to yield
+    host batches.
 
     ``checkpointer`` (a ``train.checkpoint.CheckpointManager``) saves the
     state every ``checkpoint_every`` epochs — persistence the reference
@@ -264,10 +277,26 @@ def fit(
     from machine_learning_apache_spark_tpu.utils.profiling import StepWindowTracer
     from machine_learning_apache_spark_tpu.parallel import zero as _zero
 
+    if data is not None:
+        if train_loader is not None:
+            raise ValueError("pass either train_loader or data=, not both")
+        train_loader = data
+    if train_loader is None:
+        raise ValueError("fit needs a train_loader (or data=...)")
     emit = emit or log.info
     rng = rng if rng is not None else jax.random.key(0)
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+    # Streaming-pipeline integration (duck-typed marker, no import cycle):
+    # bind fit's mesh into the pipeline's device stage — except on the
+    # host-batch paths (scan stacking, fit-side device prefetch), which
+    # place batches themselves.
+    streaming = getattr(train_loader, "is_streaming_pipeline", False)
+    if streaming:
+        if mesh is not None:
+            train_loader.bind(mesh=mesh)
+        if steps_per_call > 1 or (prefetch_to_device > 0 and mesh is not None):
+            train_loader.bind(device=False)
     mode = _zero.resolve_dp_mode(dp_mode)
     if mode == "zero1":
         # The fused sharded-update path (parallel.zero,
@@ -333,6 +362,11 @@ def fit(
             if "rng" in resume_meta:
                 rng = _rng_from_meta(resume_meta["rng"])
             start_epoch = int(resume_meta.get("epoch", -1)) + 1
+            if streaming and resume_meta.get("ingest") is not None:
+                # Stream position (mixture RNG state, per-source cursors)
+                # from the sidecar: the resumed run replays the exact
+                # batch sequence the interrupted one would have produced.
+                train_loader.load_state_dict(resume_meta["ingest"])
             emit(
                 f"resuming from checkpoint step {resumed_step} "
                 f"(starting epoch {start_epoch})"
@@ -405,6 +439,11 @@ def fit(
     finally:
         if sink is not None:
             sink.close()
+        if streaming:
+            # Producer-thread teardown on BOTH exits (return and raise):
+            # a crashed fit must not leave ingest threads pinning buffered
+            # batches (pinned by tests/test_ingest.py).
+            train_loader.shutdown()
     emit(f"Training Time: {seconds:.3f} sec")
     return FitResult(
         state=state, train_seconds=seconds, history=history,
@@ -431,6 +470,10 @@ def _run_epochs(
     use_prefetch = (
         prefetch_to_device > 0 and mesh is not None and multi_fn is None
     )
+    # A streaming pipeline with an active device stage delivers batches
+    # already placed (device_put, or mesh-sharded when fit bound a mesh);
+    # the single-step path must not re-shard them.
+    pipeline_device = getattr(train_loader, "yields_device_batches", False)
 
     history: list[dict] = []
     # On resume the step counter continues from the restored checkpoint, so
@@ -540,7 +583,7 @@ def _run_epochs(
                 if len(group) == steps_per_call:
                     _flush_group()
             else:
-                _single_step(batch, presharded=use_prefetch)
+                _single_step(batch, presharded=use_prefetch or pipeline_device)
         # Ragged trailing group: fewer than steps_per_call batches left in
         # the epoch — run them as single steps (a scan over a shorter stack
         # would force a recompile per distinct remainder length).
@@ -573,19 +616,22 @@ def _run_epochs(
             # checkpoint I/O never stalls device dispatch mid-training. The
             # sidecar meta carries the epoch counter and the post-epoch rng
             # key so fit(resume=True) continues the exact trajectory.
-            checkpointer.save(
-                state, wait=False,
-                meta={
-                    "epoch": epoch,
-                    "rng": _rng_to_meta(rng),
-                    # JSON-safe copy of this epoch's metrics, so an
-                    # already-complete resume can still report them.
-                    "metrics": {
-                        k: (v if isinstance(v, int) else float(v))
-                        for k, v in computed.items()
-                    },
+            meta = {
+                "epoch": epoch,
+                "rng": _rng_to_meta(rng),
+                # JSON-safe copy of this epoch's metrics, so an
+                # already-complete resume can still report them.
+                "metrics": {
+                    k: (v if isinstance(v, int) else float(v))
+                    for k, v in computed.items()
                 },
-            )
+            }
+            if getattr(train_loader, "is_streaming_pipeline", False):
+                # Stream cursor + sampler RNG next to the rng key: the
+                # epoch boundary is a quiescent point (the producer thread
+                # has finished the epoch), so this capture is exact.
+                meta["ingest"] = train_loader.state_dict()
+            checkpointer.save(state, wait=False, meta=meta)
         epoch_span.__exit__(None, None, None)
     return state, history
 

@@ -213,3 +213,18 @@ def echo_dp_mode():
         "dp_mode": os.environ.get("MLSPARK_DP_MODE"),
         "rank": int(os.environ.get("MLSPARK_PROCESS_ID", "-1")),
     }
+
+
+def echo_ingest_env():
+    """The ingest env contract as a worker sees it (Distributor(ingest=...)
+    must plumb MLSPARK_INGEST_* into every rank's environment), resolved
+    through IngestConfig.from_env exactly as a worker's StreamingPipeline
+    would."""
+    from machine_learning_apache_spark_tpu.ingest.config import IngestConfig
+
+    cfg = IngestConfig.from_env()
+    return {
+        "buffer": cfg.buffer,
+        "tail": cfg.tail,
+        "rank": int(os.environ.get("MLSPARK_PROCESS_ID", "-1")),
+    }

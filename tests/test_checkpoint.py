@@ -1,6 +1,8 @@
 """Checkpoint/resume tests: round-trip (incl. sharded params), latest-step
 resume, retention, and the resumed-training-continues property."""
 
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -300,6 +302,102 @@ class TestZero1Resume:
         ]
         assert any(DATA_AXIS in jax.tree.leaves(s) for s in specs), specs
         assert math.isfinite(second["final_loss"])
+
+
+class TestStreamingIngestResume:
+    """Deterministic resume through the ingest sidecar: a fit() over a
+    mixture StreamingPipeline checkpoints the sampler's RNG state and
+    stream cursors in the meta sidecar, and fit(resume=True) with a
+    FRESH pipeline replays the identical batch sequence — so the resumed
+    trajectory is bit-identical to the uninterrupted one."""
+
+    def _pipeline(self):
+        from machine_learning_apache_spark_tpu.ingest import (
+            ArraySource,
+            MixtureSampler,
+            StreamingPipeline,
+        )
+
+        gen = np.random.default_rng(0)
+        sources = {
+            "a": ArraySource(
+                gen.normal(size=(20, 4)).astype(np.float32),
+                gen.integers(0, 3, 20),
+                name="a",
+            ),
+            "b": ArraySource(
+                gen.normal(size=(13, 4)).astype(np.float32),
+                gen.integers(0, 3, 13),
+                name="b",
+            ),
+        }
+        mix = MixtureSampler(
+            sources, [0.6, 0.4], records_per_epoch=32, seed=7
+        )
+        # Host batches, no prefetch: pure determinism check (the threaded
+        # path is pinned by tests/test_ingest.py).
+        return StreamingPipeline(
+            mix, 8, tail="drop", buffer=0, device_prefetch=0
+        )
+
+    def _fit(self, pipe, epochs, ckpt=None, resume=False):
+        from machine_learning_apache_spark_tpu.train.loop import (
+            classification_loss,
+            fit,
+        )
+
+        state = make_state()
+        return fit(
+            state,
+            classification_loss(state.apply_fn),
+            data=pipe,
+            epochs=epochs,
+            log_every=0,
+            checkpointer=ckpt,
+            checkpoint_every=1,
+            resume=resume,
+        )
+
+    def test_meta_sidecar_carries_stream_state(self, tmp_path):
+        with CheckpointManager(str(tmp_path / "m")) as ckpt:
+            self._fit(self._pipeline(), epochs=1, ckpt=ckpt)
+            meta = ckpt.read_meta(ckpt.latest_step())
+        ing = meta["ingest"]
+        assert ing["epoch"] == 0
+        src = ing["source"]
+        assert "rng" in src and set(src["draws"]) == {"a", "b"}
+        assert sum(src["draws"].values()) == 32  # records_per_epoch drawn
+        # The sidecar is JSON on disk, so the state must round-trip JSON.
+        assert json.loads(json.dumps(ing)) == ing
+
+    def test_resume_replays_identical_batches(self, tmp_path):
+        uninterrupted = self._fit(self._pipeline(), epochs=4)
+
+        with CheckpointManager(str(tmp_path / "r")) as ckpt:
+            self._fit(self._pipeline(), epochs=2, ckpt=ckpt)
+        # Fresh process stand-in: a NEW pipeline (same seed, cursors at
+        # zero) — resume must fast-forward it from the sidecar, not trust
+        # in-memory state.
+        with CheckpointManager(str(tmp_path / "r")) as ckpt:
+            resumed = self._fit(
+                self._pipeline(), epochs=4, ckpt=ckpt, resume=True
+            )
+        assert resumed.resumed_step == 8  # 2 epochs × 4 batches
+        assert int(resumed.state.step) == int(uninterrupted.state.step)
+        for a, b in zip(
+            jax.tree.leaves(uninterrupted.state.params),
+            jax.tree.leaves(resumed.state.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_resume_without_sidecar_state_is_fresh_run(self, tmp_path):
+        # No checkpoint on disk: resume=True is a normal fresh run and
+        # the pipeline starts from its seed.
+        with CheckpointManager(str(tmp_path / "f")) as ckpt:
+            res = self._fit(
+                self._pipeline(), epochs=1, ckpt=ckpt, resume=True
+            )
+        assert res.resumed_step is None
 
 
 class TestParamsOnly:

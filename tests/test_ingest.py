@@ -1,0 +1,711 @@
+"""Ingest subsystem tests: streaming sources, gang batch-count
+equalization (the ragged-shard deadlock regression), online-packing
+parity with the one-shot packer, mixture determinism + checkpoint-resume
+replay, bounded prefetch with clean thread shutdown, the MLSPARK_INGEST_*
+env contract through the launcher, data.* telemetry, and the
+ingest_bench --smoke tier-1 artifact."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from machine_learning_apache_spark_tpu import ingest, telemetry
+from machine_learning_apache_spark_tpu.data.libsvm import write_libsvm
+from machine_learning_apache_spark_tpu.data.packing import (
+    pack_translation_pairs,
+)
+from machine_learning_apache_spark_tpu.ingest import (
+    ArraySource,
+    CallableSource,
+    IngestConfig,
+    LibsvmStreamSource,
+    MixtureSampler,
+    OnlinePacker,
+    PairSource,
+    StreamingPipeline,
+    WORKER_PREFIX,
+    validate_ingest_knobs,
+)
+
+pytestmark = pytest.mark.ingest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def host_pipeline(source, batch, **kw):
+    """A pipeline that yields host batches (no jax in unit tests)."""
+    kw.setdefault("device", False)
+    kw.setdefault("buffer", 0)
+    return StreamingPipeline(source, batch, **kw)
+
+
+def no_ingest_threads():
+    time.sleep(0.05)  # a joined thread can take a beat to deregister
+    return not [
+        t for t in threading.enumerate()
+        if t.name.startswith(WORKER_PREFIX) and t.is_alive()
+    ]
+
+
+def random_pairs(rng, n, lo=4, hi=18):
+    return [
+        (
+            list(rng.integers(4, 100, rng.integers(lo, hi))),
+            list(rng.integers(4, 100, rng.integers(lo + 1, hi + 2))),
+        )
+        for _ in range(n)
+    ]
+
+
+class TestSources:
+    def test_array_source_roundtrip(self, rng):
+        feats = rng.normal(size=(10, 3)).astype(np.float32)
+        labels = rng.integers(0, 2, 10)
+        recs = list(ArraySource(feats, labels))
+        assert len(recs) == 10
+        np.testing.assert_array_equal(recs[4][0], feats[4])
+        assert recs[4][1] == labels[4]
+        # Restartable: a second pass yields the same stream.
+        assert len(list(ArraySource(feats, labels))) == 10
+
+    def test_libsvm_stream_matches_bulk_reader(self, tmp_path, rng):
+        from machine_learning_apache_spark_tpu.data.libsvm import read_libsvm
+
+        feats = rng.normal(size=(37, 6)).astype(np.float32)
+        feats[rng.random(feats.shape) < 0.4] = 0.0
+        labels = rng.integers(0, 3, 37)
+        path = str(tmp_path / "part0.libsvm")
+        write_libsvm(path, feats, labels)
+        # chunk_lines smaller than the file: exercises the chunk loop.
+        src = LibsvmStreamSource(path, num_features=6, chunk_lines=10)
+        streamed = list(src)
+        frame = read_libsvm(path, num_features=6)
+        assert len(streamed) == 37
+        np.testing.assert_array_equal(
+            np.stack([r[0] for r in streamed]), frame.features
+        )
+        np.testing.assert_array_equal(
+            np.asarray([r[1] for r in streamed]), frame.labels
+        )
+
+    def test_libsvm_stream_error_names_file_and_lines(self, tmp_path):
+        path = str(tmp_path / "bad.libsvm")
+        with open(path, "w") as f:
+            f.write("1 1:0.5\n0 notanumber\n")
+        with pytest.raises(ValueError, match=r"bad\.libsvm: lines 1\.\.2"):
+            list(LibsvmStreamSource(path, num_features=2, use_native=False))
+
+    def test_libsvm_stream_feature_overflow_raises(self, tmp_path):
+        path = str(tmp_path / "wide.libsvm")
+        with open(path, "w") as f:
+            f.write("1 5:1.0\n")
+        with pytest.raises(ValueError, match="num_features"):
+            list(LibsvmStreamSource(path, num_features=3))
+
+    def test_shard_files_splits_paths(self, tmp_path):
+        paths = []
+        for i in range(5):
+            p = str(tmp_path / f"p{i}.libsvm")
+            with open(p, "w") as f:
+                f.write(f"{i} 1:1\n")
+            paths.append(p)
+        src = LibsvmStreamSource(paths, num_features=1)
+        r0 = src.shard_files(0, 2)
+        r1 = src.shard_files(1, 2)
+        assert r0.paths == paths[0::2] and r1.paths == paths[1::2]
+        with pytest.raises(ValueError, match="file-shard"):
+            src.shard_files(0, 6)
+
+
+class TestEqualization:
+    """Every rank must yield the same batch count per epoch — a ragged
+    shard that naively yields 1,1,1,0 batches deadlocks the gang's
+    epoch-tail collective."""
+
+    # N=19, world=4, B=5: ranks see 5,5,5,4 records — the classic
+    # one-rank-short epoch tail.
+    N, WORLD, B = 19, 4, 5
+
+    def _counts(self, tail):
+        feats = np.arange(self.N, dtype=np.float32).reshape(self.N, 1)
+        counts, seen = [], []
+        for rank in range(self.WORLD):
+            pipe = host_pipeline(
+                ArraySource(feats), self.B,
+                rank=rank, world=self.WORLD, tail=tail,
+            )
+            batches = list(pipe)
+            counts.append(len(batches))
+            seen.extend(
+                float(v) for b in batches for v in np.asarray(b[0]).ravel()
+            )
+        return counts, seen
+
+    def test_ragged_shard_drop_equalizes(self):
+        # Naive per-rank complete batches would be [1, 1, 1, 0] — rank 3
+        # leaves the epoch loop early and the gang hangs. The contract:
+        # every rank truncates to (N // world) // B.
+        counts, seen = self._counts("drop")
+        assert counts == [0, 0, 0, 0]
+        assert seen == []
+
+    def test_ragged_shard_pad_equalizes(self):
+        counts, seen = self._counts("pad")
+        assert counts == [1, 1, 1, 1]
+        # Pad wraps each rank's own records; every real record appears.
+        assert set(range(self.N)) <= {int(v) for v in seen}
+
+    def test_even_shard_covers_disjointly(self):
+        # 24 records over 3 ranks × B=4: no tail, shards are an exact
+        # disjoint cover of the dataset.
+        feats = np.arange(24, dtype=np.float32).reshape(24, 1)
+        all_seen = []
+        for rank in range(3):
+            pipe = host_pipeline(
+                ArraySource(feats), 4, rank=rank, world=3, tail="drop"
+            )
+            batches = list(pipe)
+            assert len(batches) == 2
+            all_seen += [
+                int(v) for b in batches for v in np.asarray(b[0]).ravel()
+            ]
+        assert sorted(all_seen) == list(range(24))
+
+    def test_drop_holdback_releases_when_allowed(self):
+        # N=20, world=1, B=5: the one-batch holdback must not swallow the
+        # final batch when the count divides evenly.
+        feats = np.arange(20, dtype=np.float32).reshape(20, 1)
+        batches = list(host_pipeline(ArraySource(feats), 5, tail="drop"))
+        assert len(batches) == 4
+
+    def test_files_mode_requires_steps_per_epoch(self, tmp_path):
+        p = str(tmp_path / "a.libsvm")
+        with open(p, "w") as f:
+            f.write("1 1:1\n")
+        src = LibsvmStreamSource([p, p], num_features=1)
+        with pytest.raises(ValueError, match="steps_per_epoch"):
+            host_pipeline(src, 2, rank=0, world=2, shard="files")
+
+    def test_files_mode_ragged_files_equalize(self, tmp_path):
+        # Rank 0's file has 7 records, rank 1's has 3 — wildly ragged
+        # I/O shards; both ranks must still yield exactly steps_per_epoch
+        # batches (the short rank wraps its local stream).
+        paths = []
+        for i, n in enumerate((7, 3)):
+            p = str(tmp_path / f"f{i}.libsvm")
+            with open(p, "w") as f:
+                for j in range(n):
+                    f.write(f"{j % 3} 1:{i}.{j}\n")
+            paths.append(p)
+        for rank in range(2):
+            pipe = host_pipeline(
+                LibsvmStreamSource(paths, num_features=1), 2,
+                rank=rank, world=2, shard="files", steps_per_epoch=4,
+            )
+            assert len(list(pipe)) == 4
+
+    def test_packed_rows_equalize(self, rng):
+        # Packing shards at packed-ROW level: per-rank row counts from a
+        # shared global stream stay equal even though rows/record vary.
+        pairs = random_pairs(rng, 60)
+        counts = []
+        for rank in range(4):
+            pipe = host_pipeline(
+                PairSource(pairs), 2, rank=rank, world=4, tail="pad",
+                pack=dict(src_len=32, trg_len=36),
+            )
+            counts.append(len(list(pipe)))
+        assert len(set(counts)) == 1 and counts[0] >= 1
+
+    def test_dataset_smaller_than_world_raises(self):
+        feats = np.ones((2, 1), np.float32)
+        pipe = host_pipeline(
+            ArraySource(feats), 1, rank=3, world=4, tail="pad"
+        )
+        with pytest.raises(ValueError, match="smaller than the world"):
+            list(pipe)
+
+
+class TestPipelineParity:
+    def test_matches_sync_dataloader(self, rng):
+        from machine_learning_apache_spark_tpu.data import (
+            ArrayDataset,
+            DataLoader,
+        )
+
+        feats = rng.normal(size=(50, 4)).astype(np.float32)
+        labels = rng.integers(0, 3, 50)
+        want = list(
+            DataLoader(
+                ArrayDataset(feats, labels), 8, shuffle=False, drop_last=True
+            )
+        )
+        got = list(
+            host_pipeline(ArraySource(feats, labels), 8, tail="drop")
+        )
+        assert len(got) == len(want)
+        for (gx, gy), (wx, wy) in zip(got, want):
+            np.testing.assert_array_equal(gx, wx)
+            np.testing.assert_array_equal(gy, wy)
+
+    def test_two_epochs_deterministic(self, rng):
+        feats = rng.normal(size=(30, 2)).astype(np.float32)
+        pipe = host_pipeline(ArraySource(feats), 4, tail="pad", buffer=2)
+        first = [np.asarray(b[0]).copy() for b in pipe]
+        second = [np.asarray(b[0]).copy() for b in pipe]
+        assert len(first) == len(second)
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
+        assert no_ingest_threads()
+
+    def test_transform_applies_per_record(self):
+        feats = np.arange(8, dtype=np.float32).reshape(8, 1)
+        pipe = host_pipeline(
+            ArraySource(feats), 4, tail="drop",
+            transform=lambda rec: (rec[0] * 2,),
+        )
+        batches = list(pipe)
+        assert len(batches) == 2
+        np.testing.assert_array_equal(
+            np.asarray(batches[0][0]).ravel(), [0, 2, 4, 6]
+        )
+
+
+class TestOnlinePackerParity:
+    def test_byte_identical_to_one_shot(self, rng):
+        pairs = random_pairs(rng, 80, lo=2, hi=22)
+        src_rows = [p[0] for p in pairs]
+        trg_rows = [p[1] for p in pairs]
+        kw = dict(src_len=32, trg_len=40, max_segments=3)
+        want = pack_translation_pairs(src_rows, trg_rows, **kw)
+
+        packer = OnlinePacker(**kw)
+        rows = [r for p in pairs if (r := packer.add(*p)) is not None]
+        if (last := packer.flush()) is not None:
+            rows.append(last)
+
+        assert len(rows) == want.src.shape[0]
+        got = tuple(np.stack([r[i] for r in rows]) for i in range(6))
+        for g, w in zip(got, want.arrays()):
+            np.testing.assert_array_equal(g, w)
+        assert packer.pair_count - packer.dropped_pairs == want.pair_count
+        assert packer.dropped_pairs == want.dropped_pairs
+        assert abs(packer.token_efficiency - want.token_efficiency) < 1e-9
+
+    def test_drop_rule_counts(self):
+        packer = OnlinePacker(src_len=8, trg_len=8)
+        assert packer.add([], [1, 2, 3]) is None  # empty src
+        assert packer.add([1], [9]) is None  # <2 trg tokens
+        assert packer.dropped_pairs == 2 and packer.pair_count == 0
+
+    def test_budget_guard_matches_one_shot(self):
+        with pytest.raises(ValueError, match="budgets"):
+            OnlinePacker(src_len=8, trg_len=1)
+
+    def test_pipeline_rejects_unknown_pack_keys(self):
+        with pytest.raises(ValueError, match="pack option"):
+            host_pipeline(
+                PairSource([([1], [1, 2])]), 1,
+                pack=dict(src_len=8, trg_len=8, typo=3),
+            )
+
+
+class TestMixture:
+    def _sources(self, rng):
+        a = ArraySource(np.zeros((6, 1), np.float32), name="a")
+        b = ArraySource(np.ones((10, 1), np.float32), name="b")
+        return {"a": a, "b": b}
+
+    def test_same_seed_same_stream(self, rng):
+        draws = []
+        for _ in range(2):
+            mix = MixtureSampler(
+                self._sources(rng), [0.3, 0.7],
+                records_per_epoch=40, seed=5,
+            )
+            draws.append([float(r[0][0]) for r in mix])
+        assert draws[0] == draws[1]
+        assert {0.0, 1.0} == set(draws[0])  # both sources actually drawn
+
+    def test_weights_zero_excludes_source(self, rng):
+        mix = MixtureSampler(
+            self._sources(rng), [0.0, 1.0], records_per_epoch=25, seed=1
+        )
+        assert {float(r[0][0]) for r in mix} == {1.0}
+
+    def test_state_roundtrip_replays_remainder(self, rng):
+        mix = MixtureSampler(
+            self._sources(rng), [0.5, 0.5], records_per_epoch=30, seed=9
+        )
+        it = iter(mix)
+        consumed = [float(next(it)[0][0]) for _ in range(13)]
+        assert len(consumed) == 13
+        snap = json.loads(json.dumps(mix.state_dict()))  # sidecar-safe
+        rest = [float(r[0][0]) for r in it] + [
+            float(r[0][0]) for r in mix
+        ]  # tail of the epoch + one more full epoch
+
+        fresh = MixtureSampler(
+            self._sources(rng), [0.5, 0.5], records_per_epoch=30, seed=9
+        )
+        fresh.load_state_dict(snap)
+        it2 = iter(fresh)
+        resumed = [float(next(it2)[0][0]) for _ in range(17)] + [
+            float(r[0][0]) for r in fresh
+        ]
+        assert resumed == rest
+
+    def test_cycle_mismatch_rejected(self, rng):
+        mix = MixtureSampler(
+            self._sources(rng), records_per_epoch=40, seed=2
+        )
+        list(mix)
+        state = mix.state_dict()
+        state["cycles"] = {n: c + 1 for n, c in state["cycles"].items()}
+        fresh = MixtureSampler(
+            self._sources(rng), records_per_epoch=40, seed=2
+        )
+        with pytest.raises(ValueError, match="cycle"):
+            fresh.load_state_dict(state)
+
+    def test_empty_source_raises(self):
+        mix = MixtureSampler(
+            {"e": ArraySource(np.zeros((0, 1), np.float32))},
+            records_per_epoch=3,
+        )
+        with pytest.raises(ValueError, match="empty"):
+            list(mix)
+
+    def test_all_ranks_see_same_global_stream(self, rng):
+        # The record-sharding precondition: identically-seeded mixtures
+        # on every rank draw the same global sequence, so rank shards are
+        # a disjoint cover of it.
+        def stream(rank, world):
+            mix = MixtureSampler(
+                self._sources(rng), [0.4, 0.6],
+                records_per_epoch=24, seed=3,
+            )
+            pipe = host_pipeline(
+                mix, 4, rank=rank, world=world, tail="pad"
+            )
+            return [
+                float(v) for b in pipe for v in np.asarray(b[0]).ravel()
+            ]
+
+        world1 = stream(0, 1)
+        sharded = [stream(r, 2) for r in range(2)]
+        # Interleave rank shards back into the global order.
+        rebuilt = [None] * 24
+        for r, vals in enumerate(sharded):
+            rebuilt[r::2] = vals[:12]
+        assert rebuilt == world1[:24]
+
+
+class TestPrefetch:
+    def test_buffer_is_bounded(self, rng):
+        telemetry.reset()
+        try:
+            feats = rng.normal(size=(64, 2)).astype(np.float32)
+            depth = 3
+            pipe = host_pipeline(
+                ArraySource(feats), 4, tail="drop", buffer=depth
+            )
+            for _ in pipe:
+                time.sleep(0.002)  # slow consumer: producer fills the queue
+            occ = [
+                ev.value for ev in telemetry.get_log().snapshot()
+                if ev.kind == "gauge" and ev.name == "data.buffer_occupancy"
+            ]
+            assert occ and max(occ) <= depth
+        finally:
+            telemetry.reset()
+        assert no_ingest_threads()
+
+    def test_producer_error_propagates_and_joins(self):
+        def bad_stream():
+            yield (np.zeros(1, np.float32),)
+            raise RuntimeError("reader exploded")
+
+        pipe = host_pipeline(
+            CallableSource(bad_stream), 1, tail="drop", buffer=2
+        )
+        with pytest.raises(RuntimeError, match="reader exploded"):
+            list(pipe)
+        assert no_ingest_threads()
+
+    def test_abandoned_iterator_shutdown_joins(self, rng):
+        feats = rng.normal(size=(400, 2)).astype(np.float32)
+        pipe = host_pipeline(ArraySource(feats), 4, tail="drop", buffer=2)
+        it = iter(pipe)
+        next(it)  # producer is now alive and likely blocked on a full queue
+        pipe.shutdown()
+        assert no_ingest_threads()
+        pipe.shutdown()  # idempotent
+
+    def test_context_manager_shuts_down(self, rng):
+        feats = rng.normal(size=(100, 2)).astype(np.float32)
+        with host_pipeline(
+            ArraySource(feats), 4, tail="drop", buffer=2
+        ) as pipe:
+            next(iter(pipe))
+        assert no_ingest_threads()
+
+
+class TestFitIntegration:
+    def _loss_and_state(self):
+        import jax
+        import jax.numpy as jnp
+
+        from machine_learning_apache_spark_tpu.models import MLP
+        from machine_learning_apache_spark_tpu.train.loop import (
+            classification_loss,
+        )
+        from machine_learning_apache_spark_tpu.train.state import (
+            TrainState,
+            make_optimizer,
+        )
+
+        model = MLP(layers=(4, 8, 3))
+        params = model.init(jax.random.key(0), jnp.ones((1, 4)))["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params,
+            tx=make_optimizer("adam", 1e-3),
+        )
+        return classification_loss(model.apply), state
+
+    def _source(self, rng, n=48):
+        return ArraySource(
+            rng.normal(size=(n, 4)).astype(np.float32),
+            rng.integers(0, 3, n),
+        )
+
+    def test_fit_data_kw_trains_and_cleans_up(self, rng):
+        from machine_learning_apache_spark_tpu.train.loop import fit
+
+        loss_fn, state = self._loss_and_state()
+        pipe = StreamingPipeline(
+            self._source(rng), 8, tail="drop", buffer=2, device_prefetch=2
+        )
+        res = fit(state, loss_fn, data=pipe, epochs=2, log_every=0)
+        assert int(res.state.step) == 12  # 2 epochs × 6 batches
+        assert np.isfinite(res.final_loss)
+        assert no_ingest_threads()  # fit's finally ran shutdown()
+
+    def test_fit_raise_path_leaves_no_threads(self, rng):
+        from machine_learning_apache_spark_tpu.train.loop import fit
+
+        loss_fn, state = self._loss_and_state()
+
+        def poisoned():
+            src = self._source(rng, 64)
+            for i, rec in enumerate(src):
+                if i == 20:
+                    raise RuntimeError("mid-epoch reader failure")
+                yield rec
+
+        pipe = StreamingPipeline(
+            CallableSource(poisoned), 8,
+            tail="drop", buffer=2, device_prefetch=2,
+        )
+        with pytest.raises(RuntimeError, match="mid-epoch reader failure"):
+            fit(state, loss_fn, data=pipe, epochs=1, log_every=0)
+        assert no_ingest_threads()
+
+    def test_both_loader_and_data_rejected(self, rng):
+        from machine_learning_apache_spark_tpu.train.loop import fit
+
+        loss_fn, state = self._loss_and_state()
+        with pytest.raises(ValueError, match="not both"):
+            fit(state, loss_fn, [], data=[], epochs=1)
+
+    def test_fit_mesh_binds_pipeline_device_stage(self, rng):
+        from machine_learning_apache_spark_tpu.parallel import (
+            DATA_AXIS,
+            make_mesh,
+        )
+        from machine_learning_apache_spark_tpu.train.loop import fit
+
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs the 8-virtual-device mesh")
+        loss_fn, state = self._loss_and_state()
+        mesh = make_mesh({DATA_AXIS: 8})
+        pipe = StreamingPipeline(
+            self._source(rng), 16, tail="drop", buffer=2, device_prefetch=2
+        )
+        res = fit(
+            state, loss_fn, data=pipe, epochs=1, log_every=0, mesh=mesh
+        )
+        assert pipe.mesh is mesh
+        assert np.isfinite(res.final_loss)
+        assert no_ingest_threads()
+
+
+class TestEnvContract:
+    def test_from_env_precedence(self, monkeypatch):
+        monkeypatch.setenv("MLSPARK_INGEST_BUFFER", "7")
+        monkeypatch.setenv("MLSPARK_INGEST_TAIL", "drop")
+        cfg = IngestConfig.from_env(tail="pad")
+        assert cfg.buffer == 7  # env wins over default
+        assert cfg.tail == "pad"  # explicit arg wins over env
+        assert cfg.device_prefetch == 2  # default
+
+    def test_bad_env_int_raises(self, monkeypatch):
+        monkeypatch.setenv("MLSPARK_INGEST_BUFFER", "many")
+        with pytest.raises(ValueError, match="MLSPARK_INGEST_BUFFER"):
+            IngestConfig.from_env()
+
+    def test_validate_knobs_mapping(self):
+        env = validate_ingest_knobs({"buffer": 4, "tail": "drop"})
+        assert env == {
+            "MLSPARK_INGEST_BUFFER": "4",
+            "MLSPARK_INGEST_TAIL": "drop",
+        }
+
+    def test_pipeline_reads_rank_world_from_env(self, monkeypatch, rng):
+        monkeypatch.setenv("MLSPARK_PROCESS_ID", "1")
+        monkeypatch.setenv("MLSPARK_NUM_PROCESSES", "2")
+        pipe = host_pipeline(
+            ArraySource(rng.normal(size=(8, 1)).astype(np.float32)), 2
+        )
+        assert (pipe.rank, pipe.world) == (1, 2)
+
+    def test_distributor_rejects_bad_knobs_at_construction(self):
+        from machine_learning_apache_spark_tpu.launcher import Distributor
+
+        with pytest.raises(ValueError, match="ingest knob"):
+            Distributor(num_processes=2, ingest={"bufer": 4})
+        with pytest.raises(ValueError, match="tail"):
+            Distributor(num_processes=2, ingest={"tail": "wrap"})
+
+    def test_gang_ingest_env_plumbing(self):
+        # Distributor(ingest=...) sets MLSPARK_INGEST_* for every rank —
+        # the env contract StreamingPipeline resolves via
+        # IngestConfig.from_env (mirror of the dp_mode plumbing test).
+        from machine_learning_apache_spark_tpu.launcher import Distributor
+
+        out = Distributor(
+            num_processes=2, platform="cpu", timeout=120,
+            ingest={"buffer": 5, "tail": "drop"},
+        ).run("launcher_workers:echo_ingest_env")
+        assert out == {"buffer": 5, "tail": "drop", "rank": 0}
+
+
+class TestTelemetryGlue:
+    def test_pipeline_emits_data_family(self, rng):
+        telemetry.reset()
+        try:
+            feats = rng.normal(size=(40, 3)).astype(np.float32)
+            pipe = host_pipeline(
+                ArraySource(feats), 8, tail="drop", buffer=2
+            )
+            n_batches = len(list(pipe))
+            evs = [ev.to_dict() for ev in telemetry.get_log().snapshot()]
+            names = {e["name"] for e in evs}
+            assert {
+                "data.read", "data.wait",
+                "data.buffer_occupancy", "data.records", "data.batches",
+            } <= names
+            reg = telemetry.get_registry().snapshot()["data"]
+            assert reg["records"] == 40
+            assert reg["batches"] == n_batches
+
+            from machine_learning_apache_spark_tpu.telemetry import aggregate
+
+            report = aggregate.ingest_report(evs)
+            assert "data.read" in report["phases"]
+            assert report["counters"]["data.records"]
+            assert report["buffer_occupancy"]
+            # No train.step events in this run: stall known, verdict None.
+            assert report["verdict"] is None
+        finally:
+            telemetry.reset()
+
+    def test_fit_run_renders_ingest_section(self, rng, tmp_path):
+        from machine_learning_apache_spark_tpu.telemetry import aggregate
+        from machine_learning_apache_spark_tpu.train.loop import fit
+
+        telemetry.reset()
+        try:
+            loss_fn, state = TestFitIntegration()._loss_and_state()
+            pipe = StreamingPipeline(
+                ArraySource(
+                    rng.normal(size=(48, 4)).astype(np.float32),
+                    rng.integers(0, 3, 48),
+                ),
+                8, tail="drop", buffer=2, device_prefetch=2,
+            )
+            fit(state, loss_fn, data=pipe, epochs=2, log_every=0)
+            telemetry.write_rank_file(str(tmp_path), rank=0)
+            report = aggregate.merge_gang_dir(str(tmp_path))
+            ing = report["ingest"]
+            assert ing["stall_fraction"] is not None
+            assert ing["verdict"] in ("input-bound", "compute-bound")
+            assert {"data.read", "data.wait", "data.h2d"} <= set(
+                ing["phases"]
+            )
+            assert ing["counters"]["data.bytes_h2d"]
+            md = aggregate.render_markdown(report)
+            assert "## Ingest (data.*)" in md
+            assert "buffer occupancy" in md.lower()
+        finally:
+            telemetry.reset()
+
+    def test_telemetry_report_cli_includes_ingest(self, tmp_path, rng):
+        telemetry.reset()
+        try:
+            pipe = host_pipeline(
+                ArraySource(rng.normal(size=(20, 2)).astype(np.float32)),
+                4, tail="drop", buffer=2,
+            )
+            list(pipe)
+            telemetry.write_rank_file(str(tmp_path), rank=0)
+        finally:
+            telemetry.reset()
+        out = tmp_path / "report.json"
+        r = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO_ROOT, "tools", "telemetry_report.py"),
+                str(tmp_path), "--json", str(out),
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        report = json.loads(out.read_text())
+        assert "data.read" in report["ingest"]["phases"]
+
+
+def test_ingest_bench_smoke_subprocess(tmp_path):
+    """tools/ingest_bench.py --smoke is the tier-1 CI entry: fresh
+    process, one tiny sweep entry, all semantic gates (sync/stream batch
+    parity, determinism, thread hygiene)."""
+    out = tmp_path / "ingest_bench.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "tools", "ingest_bench.py"),
+            "--smoke", "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    art = json.loads(out.read_text())
+    assert art["ok"] is True
+    assert art["gates"] == {
+        "parity_sync_vs_stream": True,
+        "determinism": True,
+        "threads_clean": True,
+    }
+    entry = art["sweep"][0]
+    assert {"sync", "stream_off", "stream_on"} <= set(entry)
+    assert entry["stream_on"]["batches_per_epoch"] > 0
+    assert art["packing"]["rows_packed"] < art["packing"]["rows_unpacked"]

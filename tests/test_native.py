@@ -82,6 +82,134 @@ class TestLibsvmParser:
             native.libsvm_native.parse_text("0 1:1e999\n")
 
 
+class TestStreamingParserParity:
+    """Golden parity for the ingest streaming readers: the native chunk
+    parser and the pure-Python fallback must produce bit-identical record
+    streams — including on the edge cases that historically diverge
+    (trailing-newline variants, malformed lines mid-file, chunk
+    boundaries landing on comments/blanks)."""
+
+    def _write(self, tmp_path, text, name="f.libsvm"):
+        path = str(tmp_path / name)
+        with open(path, "w") as f:
+            f.write(text)
+        return path
+
+    def _stream(self, path, use_native, chunk_lines=3):
+        from machine_learning_apache_spark_tpu.ingest import (
+            LibsvmStreamSource,
+        )
+
+        return list(
+            LibsvmStreamSource(
+                path, num_features=4, chunk_lines=chunk_lines,
+                use_native=use_native,
+            )
+        )
+
+    def _assert_stream_parity(self, path, chunk_lines=3):
+        nat = self._stream(path, True, chunk_lines)
+        py = self._stream(path, False, chunk_lines)
+        assert len(nat) == len(py)
+        for (nf, nl), (pf, pl) in zip(nat, py):
+            np.testing.assert_array_equal(nf, pf)
+            assert nf.dtype == pf.dtype == np.float32
+            assert nl == pl
+
+    def test_fixture_file_parity(self, tmp_path, rng):
+        feats = rng.normal(size=(23, 4)).astype(np.float32)
+        feats[rng.random(feats.shape) < 0.5] = 0.0
+        path = self._write(tmp_path, "")
+        write_libsvm(path, feats, rng.integers(0, 3, 23))
+        self._assert_stream_parity(path)
+
+    @pytest.mark.parametrize("tail", ["", "\n", "\n\n\n"])
+    def test_trailing_newline_variants(self, tmp_path, tail):
+        # No trailing newline, one, and several: same 2 records either way
+        # (a final blank chunk must not become a phantom record or error).
+        path = self._write(tmp_path, "1 1:0.5 3:-2\n0 2:1.25 4:3" + tail)
+        nat = self._stream(path, True, chunk_lines=1)
+        assert len(nat) == 2
+        self._assert_stream_parity(path, chunk_lines=1)
+
+    def test_comments_and_blanks_at_chunk_boundaries(self, tmp_path):
+        text = (
+            "# header comment\n"
+            "1 1:1\n"
+            "\n"
+            "0 2:2  # inline comment\n"
+            "# another\n"
+            "\n"
+            "2 4:4\n"
+        )
+        path = self._write(tmp_path, text)
+        for chunk_lines in (1, 2, 3, 100):
+            nat = self._stream(path, True, chunk_lines)
+            assert [int(l) for _, l in nat] == [1, 0, 2]
+            self._assert_stream_parity(path, chunk_lines)
+
+    def test_malformed_line_same_failure_point(self, tmp_path):
+        # Line 3 is broken: both parsers must fail, and the streaming
+        # wrapper must re-anchor the chunk-relative line number to the
+        # FILE so the operator can find the bad record.
+        path = self._write(tmp_path, "1 1:1\n0 2:2\n1 x:3\n2 4:4\n")
+        for use_native in (True, False):
+            with pytest.raises(ValueError, match=r"lines 3\.\.") as ei:
+                self._stream(path, use_native, chunk_lines=1)
+            assert "f.libsvm" in str(ei.value)
+
+    def test_streaming_matches_bulk_reader_native(self, tmp_path, rng):
+        # Stream (native chunks) vs read_libsvm (native whole-file): the
+        # same file must materialize identically through both paths.
+        feats = rng.normal(size=(17, 4)).astype(np.float32)
+        feats[rng.random(feats.shape) < 0.5] = 0.0
+        labels = rng.integers(0, 3, 17)
+        path = self._write(tmp_path, "")
+        write_libsvm(path, feats, labels)
+        streamed = self._stream(path, True, chunk_lines=5)
+        frame = read_libsvm(path, num_features=4, use_native=True)
+        np.testing.assert_array_equal(
+            np.stack([f for f, _ in streamed]), frame.features
+        )
+        np.testing.assert_array_equal(
+            np.asarray([l for _, l in streamed]), frame.labels
+        )
+
+    def test_encoded_text_source_parity_with_pipeline(self, monkeypatch):
+        # EncodedTextSource chunks through TextPipeline (native
+        # text_encode when built): the record stream must equal the
+        # one-shot pipeline call on the whole corpus, native and Python
+        # alike — including whitespace-torture rows.
+        from machine_learning_apache_spark_tpu.data.text import TextPipeline
+        from machine_learning_apache_spark_tpu.ingest import (
+            EncodedTextSource,
+        )
+
+        texts = [
+            "hello world",
+            "  collapse   whitespace\tand\nnewlines  ",
+            "trailing apostrophe '",
+            "punct-only !?.,()",
+            "don't; split: this (and) that?",
+        ]
+        labels = list(range(len(texts)))
+        pipe = TextPipeline.fit(
+            texts, "basic_english", max_seq_len=12, fixed_len=14
+        )
+
+        def stream_ids():
+            recs = list(
+                EncodedTextSource(texts, labels, pipe, chunk=2)
+            )
+            assert [int(l) for _, l in recs] == labels
+            return np.stack([ids for ids, _ in recs])
+
+        native_ids = stream_ids()
+        np.testing.assert_array_equal(native_ids, pipe(texts))
+        monkeypatch.setenv("MLSPARK_NO_NATIVE_TEXT", "1")
+        np.testing.assert_array_equal(stream_ids(), native_ids)
+
+
 class TestGatherRows:
     @pytest.mark.parametrize(
         "shape,dtype",

@@ -3,9 +3,11 @@
 The offline half of ``telemetry.aggregate``: point it at a directory of
 ``telemetry_rank<k>.jsonl`` files (a gang workdir, or wherever
 ``MLSPARK_TELEMETRY_DIR`` pointed) and get the gang-wide per-phase
-p50/p99 table, the rank-skew (straggler attribution) report, and a comms
+p50/p99 table, the rank-skew (straggler attribution) report, a comms
 section (zero1 wire bytes per step, collective span p50/p99) when the
-run recorded any ``comms.*`` events.
+run recorded any ``comms.*`` events, and an ingest section (``data.*``
+stage durations, prefetch-buffer occupancy, input-bound vs compute-bound
+verdict) when it recorded any ``data.*`` events.
 
 Usage::
 
@@ -56,6 +58,7 @@ def _report_from_files(paths: list[str]) -> dict:
         "phases": table,
         "skew": aggregate.skew_report(table),
         "comms": aggregate.comms_report(events, table),
+        "ingest": aggregate.ingest_report(events, table),
     }
 
 
