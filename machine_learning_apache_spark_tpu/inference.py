@@ -309,8 +309,15 @@ class Translator:
     def serve(self, *, start: bool = True, **engine_kwargs):
         """Continuous-batching server over this translator — the
         request-level layer ``__call__`` lacks: concurrent callers share
-        an admission queue, a shape-bucketed batcher, and a KV slot pool,
-        with every bucket's program precompiled at warmup.
+        an admission queue, and every hot step lands on a program
+        precompiled at warmup. By default (``kv_mode="paged"``) requests
+        decode out of a shared paged KV store — one ragged launch
+        program for any occupancy/length mix, chunk-padded prefill, and
+        an LRU prefix cache so repeated prompts skip their prefill;
+        ``kv_mode="padded"`` (or env ``MLSPARK_SERVE_KV_MODE``) selects
+        the legacy shape-bucketed rectangle path, which ``method="beam"``
+        still requires. Both modes produce outputs identical to
+        ``__call__`` (docs/SERVING.md).
 
         >>> with t.serve(max_batch=8, boundaries=(16, 32)) as eng:
         ...     futs = [eng.submit(s) for s in sentences]

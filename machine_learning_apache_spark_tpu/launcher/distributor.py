@@ -150,6 +150,7 @@ class Distributor:
         platform: str | None = None,
         env: dict[str, str] | None = None,
         dp_mode: str | None = None,
+        serve_kv_mode: str | None = None,
         ingest: dict | None = None,
         timeout: float = 600.0,
         max_restarts: int = 0,
@@ -175,6 +176,20 @@ class Distributor:
                 "'zero1')"
             )
         self.dp_mode = dp_mode
+        # Serving KV-cache mode, same env contract shape: the knob becomes
+        # MLSPARK_SERVE_KV_MODE in every worker, which ServingEngine
+        # resolves when kv_mode isn't passed explicitly ("paged" is the
+        # engine default; "padded" selects the legacy rectangle path as
+        # an equivalence oracle). Validated here so a typo fails in the
+        # driver, not inside every rank after rendezvous.
+        if serve_kv_mode is not None and serve_kv_mode not in (
+            "padded", "paged"
+        ):
+            raise ValueError(
+                f"unknown serve_kv_mode {serve_kv_mode!r} (expected "
+                "'padded' or 'paged')"
+            )
+        self.serve_kv_mode = serve_kv_mode
         # Input-pipeline plumbing, same shape as dp_mode: the
         # Distributor(ingest={"buffer": 4, "tail": "pad", ...}) knob
         # becomes MLSPARK_INGEST_* in every worker's environment (the
@@ -373,6 +388,10 @@ class Distributor:
             # dict(os.environ) above, and explicit env= still wins below.
             if self.dp_mode is not None:
                 env["MLSPARK_DP_MODE"] = self.dp_mode
+            # Serving KV mode rides the same contract (constructor >
+            # inherited env; explicit env= still wins below).
+            if self.serve_kv_mode is not None:
+                env["MLSPARK_SERVE_KV_MODE"] = self.serve_kv_mode
             # Ingest knobs ride the same contract: constructor > inherited
             # env (explicit env= still wins below).
             env.update(self.ingest_env)

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from machine_learning_apache_spark_tpu.ops.attention import (
     NEG_INF,
     dot_product_attention,
+    ragged_paged_attention,
 )
 from machine_learning_apache_spark_tpu.ops.masks import (
     combine_masks,
@@ -160,6 +161,9 @@ class MultiHeadAttention(nn.Module):
         kv_valid: jnp.ndarray | None = None,
         decode: bool = False,
         deterministic: bool = True,
+        paged: dict | None = None,
+        paged_cross: bool = False,
+        sow_mem_kv: bool = False,
     ) -> jnp.ndarray:
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.num_heads
@@ -167,6 +171,51 @@ class MultiHeadAttention(nn.Module):
 
         def split_heads(t, length):
             return t.reshape(b, length, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        def out_proj(t):
+            return nn.Dense(
+                cfg.d_model,
+                dtype=cfg.dtype,
+                name="out",
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), ("heads", "embed")
+                ),
+            )(t)
+
+        if paged is not None:
+            # Paged ragged decode (serving): ``x_q`` is one position per
+            # request row ([R, 1, d]); cached K/V live in the engine's
+            # shared page store and are addressed through this call's
+            # block table + per-row lengths — see
+            # ``ops.attention.ragged_paged_attention``. The projections
+            # reuse the exact Dense modules of the padded paths ("qkv" /
+            # "q" / "out"), so one set of params serves both modes.
+            if paged_cross:
+                # Cross-attention over prefilled memory pages; K/V were
+                # projected once at prefill (sow_mem_kv below) and
+                # scattered into the page store.
+                q = _dense(cfg.d_model, cfg, "q", "heads")(x_q)
+                ctx = ragged_paged_attention(
+                    q[:, 0].reshape(b, cfg.num_heads, head_dim),
+                    paged["k_pages"], paged["v_pages"],
+                    paged["table"], paged["length"],
+                )
+            else:
+                # Self-attention: project this step's Q/K/V, attend the
+                # cached pages plus the current position (the causal
+                # diagonal), and sow the new K/V so the caller can
+                # scatter them into the page store after the step.
+                qkv = _dense(3 * cfg.d_model, cfg, "qkv", "heads")(x_q)
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                self.sow("paged", "k_new", k[:, 0])
+                self.sow("paged", "v_new", v[:, 0])
+                ctx = ragged_paged_attention(
+                    q[:, 0].reshape(b, cfg.num_heads, head_dim),
+                    paged["k_pages"], paged["v_pages"],
+                    paged["table"], paged["length"],
+                    cur_k=k[:, 0], cur_v=v[:, 0],
+                )
+            return out_proj(ctx.reshape(b, 1, cfg.d_model))
 
         if x_kv is None:
             qkv = _dense(3 * cfg.d_model, cfg, "qkv", "heads")(x_q)
@@ -195,6 +244,13 @@ class MultiHeadAttention(nn.Module):
             kv = _dense(2 * cfg.d_model, cfg, "kv", "heads")(x_kv)
             k, v = jnp.split(kv, 2, axis=-1)
             q = _dense(cfg.d_model, cfg, "q", "heads")(x_q)
+            if sow_mem_kv:
+                # Paged prefill: expose the memory K/V projections so the
+                # serving runtime can scatter them into the page store —
+                # the once-per-sequence cross-attention projection that
+                # the flax decode cache otherwise keeps internal.
+                self.sow("paged", "k_mem", k)
+                self.sow("paged", "v_mem", v)
 
         if decode and x_kv is None:
             # Incremental decoding: append this step's K/V (one position per
@@ -250,14 +306,7 @@ class MultiHeadAttention(nn.Module):
             kv_valid=kv_valid,
         )
         out = out.transpose(0, 2, 1, 3).reshape(b, s_q, cfg.d_model)
-        return nn.Dense(
-            cfg.d_model,
-            dtype=cfg.dtype,
-            name="out",
-            kernel_init=nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("heads", "embed")
-            ),
-        )(out)
+        return out_proj(out)
 
 
 class FeedForward(nn.Module):
@@ -385,9 +434,13 @@ class DecoderLayer(nn.Module):
         decode: bool = False,
         deterministic: bool = True,
         token_valid=None,
+        paged_self: dict | None = None,
+        paged_mem: dict | None = None,
+        sow_mem_kv: bool = False,
     ):
         # Flags are plain positional-friendly bools so nn.remat can mark
-        # them static by argnum (7, 8, 9; self counts at 0).
+        # them static by argnum (7, 8, 9; self counts at 0). The paged_*
+        # kwargs are the serving decode path (never rematerialized).
         drop = nn.Dropout(self.cfg.dropout, deterministic=deterministic)
         attn = MultiHeadAttention(self.cfg, name="self_attn")(
             y,
@@ -396,6 +449,7 @@ class DecoderLayer(nn.Module):
             kv_valid=trg_valid,
             decode=decode,
             deterministic=deterministic,
+            paged=paged_self,
         )
         y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln1")(y + drop(attn))
         cross = MultiHeadAttention(self.cfg, name="cross_attn")(
@@ -405,6 +459,9 @@ class DecoderLayer(nn.Module):
             kv_valid=memory_valid,
             decode=decode,
             deterministic=deterministic,
+            paged=paged_mem,
+            paged_cross=paged_mem is not None,
+            sow_mem_kv=sow_mem_kv,
         )
         y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln2")(y + drop(cross))
         ffn_kw = (
@@ -412,7 +469,7 @@ class DecoderLayer(nn.Module):
             # overrides; it matches y's positions only outside decode (a
             # decode step feeds [B, 1] tokens while validity spans the
             # cache), so the decode path routes its single real token.
-            {"valid": None if decode else (
+            {"valid": None if (decode or paged_self is not None) else (
                 token_valid if token_valid is not None else trg_valid
             )}
             if self.cfg.moe_experts > 0
@@ -442,6 +499,8 @@ class Decoder(nn.Module):
         position_offset: jnp.ndarray | int = 0,
         positions=None,
         deterministic: bool = True,
+        paged: dict | None = None,
+        sow_mem_kv: bool = False,
     ):
         y = SentenceEmbedding(self.cfg.trg_vocab_size, self.cfg, name="embed")(
             trg_tokens,
@@ -454,13 +513,39 @@ class Decoder(nn.Module):
             trg_tokens != self.cfg.pad_id if self.cfg.moe_experts > 0 else None
         )
         # Remat only on the training path: the decode cache is a mutable
-        # variable collection, which jax.checkpoint cannot rewind.
+        # variable collection, which jax.checkpoint cannot rewind (and the
+        # paged/sow serving paths use keyword args remat can't thread).
         layer_cls = (
             nn.remat(DecoderLayer, static_argnums=(7, 8, 9))
-            if self.cfg.remat and not decode
+            if self.cfg.remat and not decode and paged is None
+            and not sow_mem_kv
             else DecoderLayer
         )
         for i in range(self.cfg.num_layers):
+            layer_kw = {}
+            if paged is not None:
+                # Each layer owns one [2, num_pages, page, d] plane of
+                # each page store. Self- and cross-attention address
+                # *separate* stores: the self store is the decode loop's
+                # scan carry (small — grows with generated tokens), the
+                # mem store holds prompt cross-KV and is read-only during
+                # decode, so it never rides a carry or gets copied.
+                layer_kw = dict(
+                    paged_self=dict(
+                        k_pages=paged["self_pages"][i, 0],
+                        v_pages=paged["self_pages"][i, 1],
+                        table=paged["self_table"],
+                        length=paged["self_len"],
+                    ),
+                    paged_mem=dict(
+                        k_pages=paged["mem_pages"][i, 0],
+                        v_pages=paged["mem_pages"][i, 1],
+                        table=paged["mem_table"],
+                        length=paged["mem_len"],
+                    ),
+                )
+            if sow_mem_kv:
+                layer_kw["sow_mem_kv"] = True
             y = layer_cls(self.cfg, name=f"layer_{i}")(
                 y,
                 memory,
@@ -472,6 +557,7 @@ class Decoder(nn.Module):
                 decode,
                 deterministic,
                 token_valid,
+                **layer_kw,
             )
         return y
 
@@ -589,6 +675,62 @@ class Transformer(nn.Module):
             src_valid,
             decode=True,
             position_offset=position,
+            deterministic=True,
+        )
+        return self._logits(y)
+
+    def prefill_paged(self, src_tokens):
+        """Paged-serving prefill: encode the prompt and project every
+        decoder layer's cross-attention K/V over the memory — sown into
+        the ``"paged"`` collection (``decoder/layer_i/cross_attn/
+        k_mem|v_mem``, each ``[B, S_src, d]``) for the serving runtime to
+        scatter into its page store. This is the once-per-sequence work
+        the flax decode cache does on its priming call, surfaced so the
+        cached K/V can outlive the request (prefix sharing)."""
+        src_valid = src_tokens != self.cfg.pad_id
+        memory = self.encoder(
+            src_tokens, None, src_valid, deterministic=True
+        )
+        dummy = jnp.full((src_tokens.shape[0], 1), 1, jnp.int32)
+        self.decoder(
+            dummy, memory, None, None, None, src_valid,
+            sow_mem_kv=True, deterministic=True,
+        )
+        return memory
+
+    def decode_step_paged(
+        self, token, self_pages, mem_pages, self_table, self_len,
+        mem_table, mem_len, positions,
+    ):
+        """One ragged decode step over the paged KV stores: ``token`` is
+        ``[R, 1]`` (one position per request row); ``self_pages`` and
+        ``mem_pages`` are ``[layers, 2, num_pages, page, d]`` stores —
+        the *self* store holds generated-token K/V (small, mutated every
+        step: the decode loop's scan carry), the *mem* store holds the
+        prompts' cross-attention K/V (written at prefill, read-only here,
+        so the launch program never copies it). The tables/lengths
+        address each row's pages in its store, and ``positions``
+        (``[R, 1]``) carries each row's own PE index — rows at different
+        depths of generation share one program. The step's new
+        self-attention K/V are sown into the ``"paged"`` collection
+        (``decoder/layer_i/self_attn/k_new|v_new``) for the caller to
+        scatter at each row's cursor."""
+        y = self.decoder(
+            token,
+            None,
+            None,
+            None,
+            None,
+            None,
+            paged=dict(
+                self_pages=self_pages,
+                mem_pages=mem_pages,
+                self_table=self_table,
+                self_len=self_len,
+                mem_table=mem_table,
+                mem_len=mem_len,
+            ),
+            positions=positions,
             deterministic=True,
         )
         return self._logits(y)

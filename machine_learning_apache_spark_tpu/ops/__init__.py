@@ -23,6 +23,7 @@ from machine_learning_apache_spark_tpu.ops.positional import sinusoidal_encoding
 from machine_learning_apache_spark_tpu.ops.attention import (
     attention_impl,
     dot_product_attention,
+    ragged_paged_attention,
     scaled_dot_product_attention,
     multi_head_attention_weights,
     sequence_parallel,
@@ -31,6 +32,7 @@ from machine_learning_apache_spark_tpu.ops.attention import (
 __all__ = [
     "attention_impl",
     "dot_product_attention",
+    "ragged_paged_attention",
     "make_causal_mask",
     "make_padding_mask",
     "make_attention_mask",

@@ -144,6 +144,92 @@ def scaled_dot_product_attention(
     return values
 
 
+def ragged_paged_attention(
+    query: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    cur_k: jnp.ndarray | None = None,
+    cur_v: jnp.ndarray | None = None,
+    use_pallas: bool | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One decode step of attention over a **paged** KV cache, ragged
+    across the batch (Ragged Paged Attention, arxiv 2604.15464).
+
+    Every request ``r`` attends its single query vector over the first
+    ``lengths[r]`` cached positions, gathered page-by-page through its
+    block table — so one compiled program serves any mix of sequence
+    lengths and any batch occupancy (empty rows have ``lengths == 0``
+    and a null block table).
+
+    - ``query`` — ``[R, H, dh]``, one position per request row;
+    - ``k_pages`` / ``v_pages`` — ``[num_pages, page_size, H*dh]``, the
+      shared page store (page 0 is the never-allocated null page);
+    - ``block_table`` — ``[R, P]`` int32 page ids, zero-padded past each
+      request's pages;
+    - ``lengths`` — ``[R]`` int32 valid cached positions (0 = inactive);
+    - ``cur_k`` / ``cur_v`` — optional ``[R, H*dh]``: the current step's
+      K/V, attended unconditionally (the causal diagonal) *in addition*
+      to the cached positions — this lets the caller run attention and
+      the cache scatter in the same fused step without a read-after-write
+      hazard on the page store.
+
+    Dispatch mirrors ``dot_product_attention``: a Pallas TPU kernel
+    whose block tables drive data-dependent page DMA when the layout
+    allows it (``dh % 128 == 0``, ``page_size % 8 == 0``), otherwise a
+    bit-equivalent gather + masked-softmax XLA path (the CPU tier-1
+    route, same fallback discipline as PR 7's native parsers).
+    """
+    num_rows, num_heads, head_dim = query.shape
+    page_size = k_pages.shape[1]
+    if use_pallas is None:
+        use_pallas = (
+            jax.default_backend() == "tpu"
+            and head_dim % 128 == 0
+            and page_size % 8 == 0
+        )
+    if use_pallas:
+        from machine_learning_apache_spark_tpu.ops.pallas_attention import (
+            ragged_paged_attention_kernel,
+        )
+
+        return ragged_paged_attention_kernel(
+            query, k_pages, v_pages, block_table, lengths,
+            cur_k=cur_k, cur_v=cur_v, interpret=interpret,
+        )
+    # XLA fallback: gather the block-table pages into a dense [R, W, ...]
+    # view and reuse the one masked-softmax core. Gathered-but-invalid
+    # positions (page remainders, null pages) are masked, so they
+    # contribute exactly +0.0 to the softmax sums.
+    pages_per_req = block_table.shape[1]
+    width = pages_per_req * page_size
+    k = jnp.take(k_pages, block_table, axis=0)  # [R, P, page, D]
+    v = jnp.take(v_pages, block_table, axis=0)
+    k = k.reshape(num_rows, width, num_heads, head_dim).transpose(0, 2, 1, 3)
+    v = v.reshape(num_rows, width, num_heads, head_dim).transpose(0, 2, 1, 3)
+    valid = jnp.arange(width)[None, :] < lengths[:, None]  # [R, W]
+    if cur_k is not None:
+        cur_k = cur_k.reshape(num_rows, num_heads, 1, head_dim)
+        cur_v = cur_v.reshape(num_rows, num_heads, 1, head_dim)
+        k = jnp.concatenate([k, cur_k], axis=2)
+        v = jnp.concatenate([v, cur_v], axis=2)
+        valid = jnp.concatenate(
+            [valid, jnp.ones((num_rows, 1), dtype=bool)], axis=1
+        )
+    out = scaled_dot_product_attention(
+        query[:, :, None, :], k, v, valid[:, None, None, :]
+    )[:, :, 0, :]
+    if cur_k is None:
+        # A fully-masked row (inactive: length 0, no current token) must
+        # emit zeros like the kernel's l==0 finalize path, not the dense
+        # softmax's uniform average of garbage V.
+        out = jnp.where((lengths > 0)[:, None, None], out, 0.0)
+    return out
+
+
 def dot_product_attention(
     query: jnp.ndarray,
     key: jnp.ndarray,

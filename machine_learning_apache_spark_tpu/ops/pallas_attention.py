@@ -620,3 +620,216 @@ def _flash_forward(
     if return_lse:
         return out, res[1]  # lse stays [B*H, q_pad] for the backward kernels
     return out
+
+
+# -- ragged paged-attention decode kernel ------------------------------------
+#
+# One query vector per request row, K/V gathered page-by-page through a
+# block table (Ragged Paged Attention, arxiv 2604.15464). The block table
+# and per-request lengths ride in as *scalar-prefetch* operands: the
+# index_map of the K/V page operands reads `tbl[r, p]`, so the page DMA is
+# data-dependent — the grid walks (request, page) but the pages fetched are
+# whatever the allocator handed that request, in order. Pages past a
+# request's length (block-table zero padding → the null page) are skipped
+# by `pl.when` and their lanes masked, so arbitrary raggedness — including
+# fully-inactive rows with length 0 — runs in the one compiled program.
+
+
+def _ragged_paged_kernel(
+    tbl_ref,  # scalar prefetch: [R, P] int32 block table
+    len_ref,  # scalar prefetch: [R] int32 cached lengths
+    q_ref,
+    k_ref,
+    v_ref,
+    *refs,
+    has_cur: bool,
+    num_heads: int,
+    heads_padded: int,
+    head_dim: int,
+    page_size: int,
+    num_page_steps: int,
+    scale: float,
+):
+    refs = list(refs)
+    cur_k_ref = refs.pop(0) if has_cur else None
+    cur_v_ref = refs.pop(0) if has_cur else None
+    o_ref = refs.pop(0)
+    m_scr, l_scr, acc_scr = refs
+    r = pl.program_id(0)  # request row
+    p = pl.program_id(1)  # page step (innermost, sequential)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[r]
+
+    def _scores(keys, width):
+        # Per-head block-diagonal q·kᵀ: the page store keeps heads packed
+        # in the lane dim ([page, H*dh]), so each head is a static lane
+        # slice — no in-kernel reshape/transpose of the DMA'd page.
+        rows = [
+            jax.lax.dot_general(
+                q_ref[0][h : h + 1, :],
+                keys[:, h * head_dim : (h + 1) * head_dim],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(num_heads)
+        ]
+        if heads_padded > num_heads:
+            rows.append(
+                jnp.full(
+                    (heads_padded - num_heads, width), NEG_INF, jnp.float32
+                )
+            )
+        return jnp.concatenate(rows, axis=0) * scale  # [Hs, width]
+
+    def _weighted_values(probs, values, width):
+        rows = [
+            jax.lax.dot_general(
+                probs[h : h + 1, :],
+                values[:, h * head_dim : (h + 1) * head_dim],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(num_heads)
+        ]
+        if heads_padded > num_heads:
+            rows.append(
+                jnp.zeros((heads_padded - num_heads, head_dim), jnp.float32)
+            )
+        return jnp.concatenate(rows, axis=0)  # [Hs, dh]
+
+    def _fold(s, mask, values, width):
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Explicit zero where masked: a row whose running max is still
+        # NEG_INF would otherwise see exp(0)=1 and silently average V.
+        pr = jnp.where(mask, jnp.exp(s - m_cur), 0.0)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(pr, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + _weighted_values(pr, values, width)
+        m_scr[:] = m_cur
+
+    @pl.when(p * page_size < length)
+    def _page():
+        k_idx = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        _fold(
+            _scores(k_ref[0], page_size),
+            k_idx < length,
+            v_ref[0],
+            page_size,
+        )
+
+    @pl.when(p == num_page_steps - 1)
+    def _finalize():
+        if has_cur:
+            # The current step's K/V — the causal diagonal — always valid,
+            # folded once after the cached pages. Padded head rows carry
+            # s == NEG_INF == m, so their weight exp(0) lands on zero
+            # values and the l=1 denominator still emits zeros.
+            _fold(
+                _scores(cur_k_ref[0], 1),
+                jnp.ones((1, 1), dtype=bool),
+                cur_v_ref[0],
+                1,
+            )
+        l = l_scr[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def ragged_paged_attention_kernel(
+    query: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    cur_k: jnp.ndarray | None = None,
+    cur_v: jnp.ndarray | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas form of ``ops.attention.ragged_paged_attention`` (see there
+    for the contract). Grid ``(requests, page_steps)`` with the page axis
+    sequential so the online-softmax scratch survives a request's sweep;
+    K/V operands are one page per step, addressed through the
+    scalar-prefetched block table. On TPU this wants ``dh % 128 == 0``
+    and ``page_size % 8 == 0`` (the dispatcher's gate); interpret mode
+    (CPU tests) takes any shape."""
+    num_rows, num_heads, head_dim = query.shape
+    page_size, d_model = k_pages.shape[1], k_pages.shape[2]
+    pages_per_req = block_table.shape[1]
+    heads_padded = max(8, num_heads)
+    if heads_padded > num_heads:
+        query = jnp.pad(
+            query, ((0, 0), (0, heads_padded - num_heads), (0, 0))
+        )
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, heads_padded, head_dim), lambda r, p, tbl, lens: (r, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, page_size, d_model),
+            lambda r, p, tbl, lens: (tbl[r, p], 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, page_size, d_model),
+            lambda r, p, tbl, lens: (tbl[r, p], 0, 0),
+        ),
+    ]
+    operands = [
+        block_table.astype(jnp.int32),
+        lengths.astype(jnp.int32),
+        query,
+        k_pages,
+        v_pages,
+    ]
+    if cur_k is not None:
+        operands += [cur_k[:, None, :], cur_v[:, None, :]]
+        in_specs += [
+            pl.BlockSpec((1, 1, d_model), lambda r, p, tbl, lens: (r, 0, 0)),
+            pl.BlockSpec((1, 1, d_model), lambda r, p, tbl, lens: (r, 0, 0)),
+        ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(num_rows, pages_per_req),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, heads_padded, head_dim), lambda r, p, tbl, lens: (r, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((heads_padded, 1), jnp.float32),
+            pltpu.VMEM((heads_padded, 1), jnp.float32),
+            pltpu.VMEM((heads_padded, head_dim), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _ragged_paged_kernel,
+            has_cur=cur_k is not None,
+            num_heads=num_heads,
+            heads_padded=heads_padded,
+            head_dim=head_dim,
+            page_size=page_size,
+            num_page_steps=pages_per_req,
+            scale=1.0 / math.sqrt(head_dim),
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (num_rows, heads_padded, head_dim), query.dtype
+        ),
+        compiler_params=pallas_tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*operands)
+    return out[:, :num_heads]

@@ -3,24 +3,44 @@
 The repo's inference story stops at ``inference.Translator`` — a one-shot,
 caller-owns-the-batch API. This package adds the layer the ROADMAP's
 "millions of users" north star needs: concurrent callers share a bounded
-admission queue (``queue``), a continuous batcher groups compatible
-requests into padded shape buckets so every batch hits an
-already-compiled XLA program (``batcher``), a fixed KV slot pool bounds
-in-flight decode state (``kv_slots``), and a background engine drives the
-cached decoders batch-by-batch (``engine``) while ``metrics`` keeps the
-latency/throughput ledger. Entry point: ``Translator.serve()``.
+admission queue (``queue``), and a background engine (``engine``) drives
+one of two KV disciplines while ``metrics`` keeps the latency/throughput
+ledger (padding-waste accounting included). Entry point:
+``Translator.serve()``.
+
+- **paged** (default): a refcounted page pool + prefix cache
+  (``kv_pages``) backs one device page store; a token-budget admission
+  picker (``batcher.TokenBudgetBatcher``) paces chunked prefill; one
+  compiled ragged decode program serves any occupancy/length mix
+  (``paged_runtime``).
+- **padded** (oracle/legacy): a continuous batcher groups requests into
+  padded shape buckets so every batch hits an already-compiled XLA
+  program (``batcher.Batcher``), and a fixed KV slot pool bounds
+  in-flight decode state (``kv_slots``).
 """
 
-from machine_learning_apache_spark_tpu.serving.batcher import Batch, Batcher
+from machine_learning_apache_spark_tpu.serving.batcher import (
+    Batch,
+    Batcher,
+    TokenBudgetBatcher,
+)
 from machine_learning_apache_spark_tpu.serving.engine import (
     EngineStopped,
     InternalError,
     ServingEngine,
 )
+from machine_learning_apache_spark_tpu.serving.kv_pages import (
+    NULL_PAGE,
+    KVPagePool,
+    PrefixCache,
+)
 from machine_learning_apache_spark_tpu.serving.kv_slots import KVSlotPool
 from machine_learning_apache_spark_tpu.serving.metrics import (
     Histogram,
     ServingMetrics,
+)
+from machine_learning_apache_spark_tpu.serving.paged_runtime import (
+    PagedDecodeRuntime,
 )
 from machine_learning_apache_spark_tpu.serving.queue import (
     Backpressure,
@@ -37,9 +57,14 @@ __all__ = [
     "EngineStopped",
     "Histogram",
     "InternalError",
+    "KVPagePool",
     "KVSlotPool",
+    "NULL_PAGE",
+    "PagedDecodeRuntime",
+    "PrefixCache",
     "RequestQueue",
     "ServeRequest",
     "ServingEngine",
     "ServingMetrics",
+    "TokenBudgetBatcher",
 ]

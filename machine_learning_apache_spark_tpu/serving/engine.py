@@ -19,6 +19,7 @@ and is the demo/bench acceptance gate.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Sequence
@@ -28,7 +29,11 @@ import numpy as np
 
 from machine_learning_apache_spark_tpu import telemetry
 from machine_learning_apache_spark_tpu.data.text import EOS_ID, SOS_ID
-from machine_learning_apache_spark_tpu.serving.batcher import Batch, Batcher
+from machine_learning_apache_spark_tpu.serving.batcher import (
+    Batch,
+    Batcher,
+    TokenBudgetBatcher,
+)
 from machine_learning_apache_spark_tpu.serving.kv_slots import KVSlotPool
 from machine_learning_apache_spark_tpu.serving.metrics import ServingMetrics
 from machine_learning_apache_spark_tpu.serving.queue import (
@@ -65,11 +70,24 @@ class ServingEngine:
     ...     futs = [eng.submit(s) for s in texts]
     ...     outs = [f.result(timeout=30) for f in futs]
 
-    Tuning knobs (see docs/SERVING.md): ``boundaries`` pick the padded
-    shapes (and so the compile set), ``max_batch`` the throughput/memory
-    trade, ``max_wait_s`` the co-batching patience (tail latency bound),
-    ``max_queue_depth`` the backpressure point, ``num_slots`` the
-    in-flight ceiling.
+    Two KV disciplines share this front door (``kv_mode``, default
+    ``"paged"``, env ``MLSPARK_SERVE_KV_MODE``):
+
+    - **paged** — a page-table KV store and ONE compiled ragged decode
+      program for any batch occupancy/length mix, chunk-padded prefill,
+      refcounted prefix sharing, immediate FIFO admission;
+    - **padded** — the legacy per-bucket rectangle programs, kept as the
+      equivalence oracle (greedy outputs are token-identical) and the
+      beam-search path.
+
+    Tuning knobs (see docs/SERVING.md): ``boundaries`` bound prompt
+    length (and pick the padded compile set), ``max_batch`` the padded
+    batch shape, ``max_wait_s`` the padded co-batching patience,
+    ``max_queue_depth`` the backpressure point; paged mode adds
+    ``max_active`` (concurrent rows), ``page_size``/``num_pages`` (KV
+    granularity/budget), ``prefill_chunk``+``prefill_budget`` (chunked-
+    prefill pacing), ``steps_per_launch`` (decode steps per dispatch),
+    ``prefix_cache_size`` (shared-prefix entries).
     """
 
     def __init__(
@@ -86,6 +104,14 @@ class ServingEngine:
         method: str = "greedy",
         beam_size: int = 4,
         length_penalty: float = 0.6,
+        kv_mode: str | None = None,
+        page_size: int = 8,
+        prefill_chunk: int | None = None,
+        steps_per_launch: int = 4,
+        max_active: int | None = None,
+        num_pages: int | None = None,
+        prefix_cache_size: int = 32,
+        prefill_budget: int | None = None,
         clock=time.monotonic,
     ):
         cfg = translator.model.cfg
@@ -100,6 +126,19 @@ class ServingEngine:
             raise ValueError(
                 f"method must be 'greedy' or 'beam', got {method!r}"
             )
+        if kv_mode is None:
+            kv_mode = os.environ.get("MLSPARK_SERVE_KV_MODE", "paged")
+        if kv_mode not in ("padded", "paged"):
+            raise ValueError(
+                f"kv_mode must be 'padded' or 'paged', got {kv_mode!r} "
+                "(check MLSPARK_SERVE_KV_MODE)"
+            )
+        if method == "beam" and kv_mode == "paged":
+            # Beam search rides the dense flax-cache decoder (hypothesis
+            # rows share and reorder KV); the paged store has no story
+            # for that yet, so beam engines run the padded path.
+            log.info("beam method: routing kv_mode paged -> padded")
+            kv_mode = "padded"
         self.translator = translator
         self.boundaries = boundaries
         self.max_batch = max_batch
@@ -107,6 +146,7 @@ class ServingEngine:
             cfg.max_len - 1 if max_new_tokens is None else max_new_tokens
         )
         self.method = method
+        self.kv_mode = kv_mode
         self.clock = clock
         self.metrics = ServingMetrics(clock=clock)
         self.queue = RequestQueue(
@@ -119,8 +159,49 @@ class ServingEngine:
             max_batch=max_batch,
             max_wait_s=max_wait_s,
         )
-        # 2× max_batch by default: one batch decoding plus one forming.
-        self.pool = KVSlotPool(num_slots or 2 * max_batch)
+        if kv_mode == "paged":
+            from machine_learning_apache_spark_tpu.serving.paged_runtime import (
+                PagedDecodeRuntime,
+            )
+
+            self.max_active = max_active or max_batch
+            if prefill_chunk is None:
+                prefill_chunk = max(
+                    page_size, boundaries[0] // page_size * page_size
+                )
+            self.prefill_chunk = prefill_chunk
+            # Chunked-prefill pacing: at most this many chunk-padded
+            # prompt tokens prefill between consecutive decode launches,
+            # so admission bursts can't stall in-flight rows' next token.
+            self.prefill_budget = (
+                prefill_budget
+                if prefill_budget is not None
+                else 2 * -(-boundaries[-1] // prefill_chunk) * prefill_chunk
+            )
+            self.runtime = PagedDecodeRuntime(
+                translator.model, translator.params,
+                max_active=self.max_active,
+                max_src=boundaries[-1],
+                max_new_tokens=self.max_new_tokens,
+                page_size=page_size,
+                prefill_chunk=prefill_chunk,
+                steps_per_launch=steps_per_launch,
+                num_pages=num_pages,
+                prefix_cache_size=prefix_cache_size,
+                sos_id=SOS_ID, eos_id=EOS_ID, pad_id=cfg.pad_id,
+            )
+            # The row pool: one slot = one cache row in the launch
+            # program (``num_slots`` is a padded-path knob; the paged
+            # in-flight ceiling is ``max_active``).
+            self.pool = KVSlotPool(self.max_active)
+            self.paged_batcher = TokenBudgetBatcher(
+                self.queue, chunk=prefill_chunk
+            )
+        else:
+            self.max_active = max_batch
+            self.runtime = None
+            # 2× max_batch by default: one batch decoding plus one forming.
+            self.pool = KVSlotPool(num_slots or 2 * max_batch)
         self._decoders = {
             b: self._make_decoder(beam_size, length_penalty)
             for b in boundaries
@@ -128,8 +209,9 @@ class ServingEngine:
         self._compiles_at_warmup: int | None = None
         self._stop = threading.Event()
         self._worker: threading.Thread | None = None
-        # Monotonic sequence over dispatched batches — the ``decode_batch``
-        # fault-injection coordinate (worker thread only; no lock needed).
+        # Monotonic sequence over dispatched batches/launches — the
+        # ``decode_batch`` fault-injection coordinate (worker thread
+        # only; no lock needed).
         self._batch_seq = 0
 
     def _make_decoder(self, beam_size: int, length_penalty: float):
@@ -191,8 +273,20 @@ class ServingEngine:
 
     # -- warmup / compile accounting ----------------------------------------
     def warmup(self) -> int:
-        """Precompile every bucket's program on dummy full-size batches so
-        no live request ever pays a compile. Returns the program count."""
+        """Precompile every program a live request could need — padded:
+        one decoder per bucket; paged: one prefill per chunk count plus
+        the single ragged launch — so no request ever pays a compile.
+        Returns the program count."""
+        if self.kv_mode == "paged":
+            with annotate("serve_warmup_paged"):
+                n = self.runtime.warmup()
+            self._compiles_at_warmup = self.compile_count()
+            log.info(
+                "warmup compiled %d paged programs (%d prefill widths + 1 "
+                "launch; max_active=%d, page_size=%d)",
+                n, n - 1, self.max_active, self.runtime.page_size,
+            )
+            return n
         params = self.translator.params
         row = [SOS_ID, EOS_ID]
         for b in self.boundaries:
@@ -208,14 +302,18 @@ class ServingEngine:
         return len(self.boundaries)
 
     def compile_count(self) -> int | None:
-        """Total compiled programs across the bucket decoders, read from
-        each jitted callable's cache (None if the jax build doesn't
-        expose the probe)."""
+        """Total compiled programs across every jitted callable the
+        engine owns — bucket decoders plus, in paged mode, the runtime's
+        prefill/launch programs (None if the jax build doesn't expose
+        the probe)."""
         from machine_learning_apache_spark_tpu.utils.compilation_cache import (
             jit_cache_size,
         )
 
-        sizes = [jit_cache_size(d) for d in self._decoders.values()]
+        fns = list(self._decoders.values())
+        if self.runtime is not None:
+            fns += self.runtime.jit_fns()
+        sizes = [jit_cache_size(f) for f in fns]
         if any(s is None for s in sizes):
             return None
         return sum(sizes)
@@ -282,6 +380,9 @@ class ServingEngine:
                 self.metrics.on_loop_restart()
 
     def _decode_loop(self) -> None:
+        if self.kv_mode == "paged":
+            self._paged_loop()
+            return
         while not self._stop.is_set():
             batch = self.batcher.next_batch(timeout=0.05)
             if batch is None:
@@ -290,6 +391,176 @@ class ServingEngine:
                 self._run_batch(batch)
             except Exception as e:  # noqa: BLE001 — a batch must never kill the loop
                 self._quarantine(batch, e)
+
+    # -- the paged decode loop ----------------------------------------------
+    def _paged_loop(self) -> None:
+        """Continuous paged serving: admit FIFO requests into free cache
+        rows (chunk-budgeted prefill), launch ``steps_per_launch`` ragged
+        decode steps over every occupied row, retire rows as they finish.
+        A raised launch or admission quarantines the active set only —
+        same inner containment ring as the padded loop."""
+        while not self._stop.is_set():
+            try:
+                self.queue.expire_overdue()
+                idle = not self.runtime.any_active()
+                self._paged_admit(timeout=0.05 if idle else 0.0)
+                if self._stop.is_set():
+                    break
+                if not self.runtime.any_active():
+                    continue
+                self._paged_step()
+            except Exception as e:  # noqa: BLE001 — contain, keep serving
+                self._paged_quarantine(e)
+        self._paged_fail_active(EngineStopped("serving engine stopped"))
+
+    def _admission_cost(self, req) -> int:
+        """Prefill tokens admitting ``req`` will actually compute: zero
+        for a prefix-cache hit (pages attach, no program runs), the
+        chunk-padded prompt width otherwise. Racy against eviction — a
+        stale zero only means one admission cycle briefly exceeds the
+        budget, which the budget's own FIFO-prefix rule already permits
+        for the head request."""
+        if self.runtime.prefix_cache.contains(tuple(req.ids)):
+            return 0
+        return self.paged_batcher.cost(req.ids)
+
+    def _paged_admit(self, timeout: float = 0.0) -> None:
+        """Move pending requests onto free rows, bounded by the prefill
+        token budget (chunked-prefill pacing). On page-pool pressure the
+        untaken tail goes back to the queue head — transient, not an
+        error."""
+        taken = self.paged_batcher.take(
+            max_requests=self.pool.free,
+            token_budget=self.prefill_budget,
+            timeout=timeout,
+            cost_fn=self._admission_cost,
+        )
+        for i, req in enumerate(taken):
+            if self._stop.is_set():
+                self.queue.requeue_front(taken[i:])
+                return
+            row = self.pool.try_acquire(req.id)
+            if row is None:  # unreachable: take() is bounded by free rows
+                self.queue.requeue_front(taken[i:])
+                return
+            res = self.runtime.admit(req, row)
+            if res is None:
+                # Page pool full even after cache eviction: give the row
+                # back and retry once in-flight rows free pages.
+                self.pool.release_owner(req.id)
+                self.queue.requeue_front(taken[i:])
+                return
+            kind, computed, real = res
+            req.admit_time = self.clock()
+            self.metrics.on_token_slots(
+                real=0 if kind == "hit" else real, padded=computed
+            )
+
+    def _paged_step(self) -> None:
+        """One fault-injection point, one page-growth pass, one compiled
+        launch, then host-side retirement of every finished row."""
+        seq = self._batch_seq
+        self._batch_seq += 1
+        maybe_fault("decode_batch", batch=seq)
+        for row in self.runtime.grow():
+            req = self.runtime.retire(row)
+            self.pool.release_owner(req.id)
+            if not req.future.done():
+                req.future.set_exception(InternalError(
+                    "kv page pool exhausted mid-decode; size num_pages "
+                    "for the worst case (the default does)"
+                ))
+                self.metrics.on_failure(1)
+        n_active = self.runtime.active_count()
+        if n_active == 0:
+            return
+        t0 = self.clock()
+        with telemetry.span(
+            "serving.batch", mode="paged", rows=n_active,
+            steps=self.runtime.steps_per_launch,
+        ), annotate("serve_decode_paged"):
+            result = self.runtime.launch()
+        decode_done = self.clock()
+        decode_s = decode_done - t0
+        for req in result.first_emits:
+            req.decode_done_time = decode_done
+        vocab = self.translator.trg_pipe.vocab
+        n_completed = 0
+        for req, ids, row, saw_eos in result.completed:
+            self.runtime.retire(row)
+            self.pool.release_owner(req.id)
+            req.future.set_result(" ".join(vocab.lookup_tokens(ids)))
+            n_completed += 1
+            now = self.clock()
+            self.metrics.on_complete(
+                queue_wait=(req.admit_time or req.submit_time)
+                - req.submit_time,
+                ttft=(req.decode_done_time or now) - req.submit_time,
+                total=now - req.submit_time,
+            )
+        # Token ledger parity with the padded path (len(content)+1 per
+        # request): real emits count EOS when emitted; a budget-exhausted
+        # row gets its implicit stop token here.
+        new_tokens = result.real_tokens + sum(
+            1 for *_ , saw_eos in result.completed if not saw_eos
+        )
+        self.metrics.on_token_slots(
+            real=result.real_tokens, padded=result.computed_slots
+        )
+        if n_completed:
+            self.queue.note_serviced(n_completed, decode_s)
+        self.metrics.on_batch(
+            n_requests=n_active,
+            max_batch=self.max_active,
+            decode_s=decode_s,
+            new_tokens=new_tokens,
+            queue_depth=self.queue.depth,
+            slot_occupancy=self.runtime.mem_pool.occupancy,
+        )
+
+    def _paged_quarantine(self, exc: Exception) -> None:
+        """Contain a failed launch/admission: the page store's contents
+        are suspect, so every active request fails with ``InternalError``
+        and the store resets (same shapes — zero recompiles); everything
+        still queued keeps flowing."""
+        if self._stop.is_set():
+            return
+        active = self.runtime.reset()
+        log.info("quarantining paged launch of %d: %r", len(active), exc)
+        telemetry.annotate(
+            "serving.quarantine", mode="paged", requests=len(active),
+            error=type(exc).__name__,
+        )
+        n = 0
+        for req in active:
+            self.pool.release_owner(req.id)
+            if not req.future.done():
+                err = InternalError(
+                    f"decode batch failed internally ({type(exc).__name__});"
+                    " only the active paged rows are affected"
+                )
+                err.__cause__ = exc
+                req.future.set_exception(err)
+                n += 1
+        self.metrics.on_quarantine(n)
+        self.metrics.on_failure(n)
+        telemetry.dump_flight(
+            f"serving.quarantine:{type(exc).__name__}",
+            extra={"mode": "paged", "requests_failed": n},
+        )
+
+    def _paged_fail_active(self, exc: Exception) -> None:
+        """Engine stopping with rows mid-decode: fail them terminally so
+        the admission ledger still balances."""
+        n = 0
+        for req in self.runtime.reset():
+            self.pool.release_owner(req.id)
+            if not req.future.done():
+                req.future.set_exception(exc)
+                n += 1
+        if n:
+            self.metrics.on_failure(n)
+            log.info("engine stop failed %d in-flight paged rows", n)
 
     def _quarantine(self, batch: Batch, exc: Exception) -> None:
         """Contain one failed batch: free its KV slots, fail its (and only
@@ -351,7 +622,8 @@ class ServingEngine:
 
     def _run_batch(self, batch: Batch) -> None:
         with telemetry.span(
-            "serving.batch", boundary=batch.boundary, size=len(batch.requests)
+            "serving.batch", mode="padded", boundary=batch.boundary,
+            size=len(batch.requests),
         ):
             self._run_batch_inner(batch)
 
@@ -391,9 +663,11 @@ class ServingEngine:
         )
         vocab = self.translator.trg_pipe.vocab
         new_tokens = 0
+        real_decode = 0
         for r, row in zip(members, rows):
             r.decode_done_time = decode_done
             new_tokens += len(row) + 1  # emitted ids + the eos/stop token
+            real_decode += min(len(row) + 1, self.max_new_tokens)
             text = " ".join(vocab.lookup_tokens(row))
             # Slot frees at EOS — the row is done generating either way
             # (eos emitted, or the max_new_tokens budget is exhausted).
@@ -405,6 +679,14 @@ class ServingEngine:
                 ttft=decode_done - r.submit_time,
                 total=done - r.submit_time,
             )
+        # Padding-waste ledger: the rectangle this batch computed (every
+        # row, filler included, at full boundary/budget width) versus the
+        # tokens that were real.
+        self.metrics.on_token_slots(
+            real=sum(min(len(r.ids), batch.boundary) for r in members)
+            + real_decode,
+            padded=self.max_batch * (batch.boundary + self.max_new_tokens),
+        )
         decode_s = decode_done - batch_start
         self.queue.note_serviced(len(members), decode_s)
         self.metrics.on_batch(

@@ -16,7 +16,9 @@ occupancy/high-water counters the metrics ledger reports.
 
 from __future__ import annotations
 
+import itertools
 import threading
+from collections import deque
 
 
 class KVSlotPool:
@@ -28,15 +30,23 @@ class KVSlotPool:
         self._free = list(range(num_slots - 1, -1, -1))  # stack, slot 0 first
         self._owner_of_slot: dict[int, int] = {}
         self._slots_of_owner: dict[int, list[int]] = {}
+        # FIFO ticket queue for blocking acquirers: a waiter may only take
+        # capacity when its ticket is at the head, so a large all-or-nothing
+        # batch cannot be starved by a stream of small batches arriving
+        # later and skimming slots as they free.
+        self._tickets: deque[int] = deque()
+        self._next_ticket = itertools.count()
         self.total_acquired = 0
         self.total_released = 0
         self.high_water = 0
 
     # -- acquisition ---------------------------------------------------------
     def try_acquire(self, owner_id: int) -> int | None:
-        """One slot for ``owner_id``, or None if the pool is dry."""
+        """One slot for ``owner_id``, or None if the pool is dry. Yields
+        to queued blocking acquirers — a non-blocking grab must not skim
+        a slot an earlier ``acquire_many`` is waiting on."""
         with self._cond:
-            if not self._free:
+            if not self._free or self._tickets:
                 return None
             return self._take_locked(owner_id)
 
@@ -46,19 +56,32 @@ class KVSlotPool:
         """Slots for a whole batch, all-or-nothing; blocks up to
         ``timeout`` for enough capacity. All-or-nothing keeps a formed
         batch indivisible — partial grants would strand requests that the
-        batcher already removed from the queue."""
+        batcher already removed from the queue. Grants are FIFO in arrival
+        order: a waiter only takes slots once every earlier waiter has
+        been served, so a full-pool batch eventually drains instead of
+        being starved by smaller batches slipping in behind it."""
         if len(owner_ids) > self.num_slots:
             raise ValueError(
                 f"batch of {len(owner_ids)} can never fit a pool of "
                 f"{self.num_slots} slots"
             )
+        ticket = next(self._next_ticket)
         with self._cond:
-            ok = self._cond.wait_for(
-                lambda: len(self._free) >= len(owner_ids), timeout
-            )
-            if not ok:
-                return None
-            return [self._take_locked(o) for o in owner_ids]
+            self._tickets.append(ticket)
+            try:
+                ok = self._cond.wait_for(
+                    lambda: (
+                        self._tickets[0] == ticket
+                        and len(self._free) >= len(owner_ids)
+                    ),
+                    timeout,
+                )
+                if not ok:
+                    return None
+                return [self._take_locked(o) for o in owner_ids]
+            finally:
+                self._tickets.remove(ticket)
+                self._cond.notify_all()
 
     def _take_locked(self, owner_id: int) -> int:
         slot = self._free.pop()

@@ -29,6 +29,9 @@ import threading
 import time
 
 from machine_learning_apache_spark_tpu.telemetry import (
+    events as telemetry_events,
+)
+from machine_learning_apache_spark_tpu.telemetry import (
     registry as telemetry_registry,
 )
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
@@ -115,6 +118,14 @@ class ServingMetrics:
         # throughput
         self.batches = 0
         self.tokens_out = 0
+        # padding-waste accounting: of every token slot the compiled
+        # programs computed (prefill + decode), how many carried a real
+        # token? The padded path pays rectangle slots (max_batch x
+        # boundary, max_batch x max_new_tokens); the paged path pays
+        # chunk-padded prefill and max_active x steps launches. The gap
+        # is the waste the paged KV layer exists to shrink.
+        self.real_tokens = 0
+        self.padded_tokens = 0
         # latency histograms (seconds)
         self.queue_wait = Histogram("queue_wait_s")
         self.ttft = Histogram("ttft_s")
@@ -134,6 +145,7 @@ class ServingMetrics:
             for name in (
                 "submitted", "completed", "rejected", "expired", "failed",
                 "quarantined", "loop_restarts", "batches", "tokens_out",
+                "real_tokens", "padded_tokens",
             )
         }
 
@@ -188,6 +200,31 @@ class ServingMetrics:
         self.queue_depth.record(queue_depth)
         self.slot_occupancy.record(slot_occupancy)
 
+    def on_token_slots(self, *, real: int, padded: int) -> None:
+        """Account one program dispatch's token slots: ``padded`` slots
+        computed, of which ``real`` carried live tokens (``real <=
+        padded`` by construction). Cache-hit prefills compute nothing and
+        contribute (0, 0)."""
+        if real > padded:
+            raise ValueError(
+                f"real tokens ({real}) cannot exceed computed slots "
+                f"({padded})"
+            )
+        with self._lock:
+            self.real_tokens += real
+            self.padded_tokens += padded
+        self._reg_counters["real_tokens"].inc(real)
+        self._reg_counters["padded_tokens"].inc(padded)
+        # Event-stream mirror so the gang-level telemetry report
+        # (telemetry.aggregate.serving_report) can compute waste across
+        # ranks from merged rank files.
+        if telemetry_events.enabled():
+            log_ = telemetry_events.get_log()
+            log_.emit("counter", "serving.tokens_real", value=float(real))
+            log_.emit(
+                "counter", "serving.tokens_padded", value=float(padded)
+            )
+
     def on_complete(self, *, queue_wait: float, ttft: float, total: float) -> None:
         with self._lock:
             self.completed += 1
@@ -237,7 +274,17 @@ class ServingMetrics:
         elapsed = self.clock() - self.started_at
         return self.tokens_out / elapsed if elapsed > 0 else 0.0
 
+    @property
+    def padding_waste(self) -> float | None:
+        """Fraction of computed token slots that carried padding, 0-1
+        (None before any slots are accounted)."""
+        with self._lock:
+            if self.padded_tokens == 0:
+                return None
+            return 1.0 - self.real_tokens / self.padded_tokens
+
     def summary(self) -> dict:
+        waste = self.padding_waste
         return {
             "submitted": self.submitted,
             "completed": self.completed,
@@ -249,6 +296,9 @@ class ServingMetrics:
             "batches": self.batches,
             "tokens_out": self.tokens_out,
             "tokens_per_sec": round(self.tokens_per_sec, 1),
+            "real_tokens": self.real_tokens,
+            "padded_tokens": self.padded_tokens,
+            "padding_waste": None if waste is None else round(waste, 4),
             "queue_wait_s": self.queue_wait.summary(),
             "ttft_s": self.ttft.summary(),
             "total_latency_s": self.total_latency.summary(),
@@ -263,12 +313,13 @@ class ServingMetrics:
         log.info(
             "serving: %d completed / %d submitted (%d rejected, %d expired,"
             " %d failed) | %d batches, %d tokens @ %.1f tok/s | total p50 %s"
-            " p99 %s | batch occupancy p50 %s",
+            " p99 %s | batch occupancy p50 %s | padding waste %s",
             s["completed"], s["submitted"], s["rejected"], s["expired"],
             s["failed"], s["batches"], s["tokens_out"], s["tokens_per_sec"],
             _fmt(s["total_latency_s"].get("p50")),
             _fmt(s["total_latency_s"].get("p99")),
             _fmt(s["batch_occupancy"].get("p50")),
+            _fmt(s["padding_waste"]),
         )
         return s
 

@@ -64,10 +64,14 @@ class ServeRequest:
     deadline: float | None = None
     id: int = dataclasses.field(default_factory=lambda: next(_REQUEST_IDS))
     future: Future = dataclasses.field(default_factory=Future)
-    # Stamped by the engine: when this request's batch finished decoding
-    # (its first-token-available time — batch decode emits all tokens at
-    # once, so TTFT and decode-done coincide here).
+    # Stamped by the engine: when this request's first token became
+    # available (padded path: batch decode emits all tokens at once, so
+    # TTFT and decode-done coincide; paged path: end of the launch that
+    # produced the first emit).
     decode_done_time: float | None = None
+    # Stamped by the paged engine when the request leaves the queue for a
+    # cache row (queue-wait measurement point).
+    admit_time: float | None = None
     slot: int | None = None
 
     def expired(self, now: float) -> bool:
@@ -154,6 +158,19 @@ class RequestQueue:
         holds ``cond``."""
         chosen = {r.id for r in requests}
         self._pending = [r for r in self._pending if r.id not in chosen]
+
+    def requeue_front(self, requests: Sequence[ServeRequest]) -> None:
+        """Put admission-rollback requests back at the **head** of the
+        queue in their original order (the paged engine took them but the
+        page pool momentarily could not hold them). Deliberately exempt
+        from the depth bound: these requests were already admitted once,
+        and bouncing them now would turn a transient pool blip into
+        client-visible rejections."""
+        if not requests:
+            return
+        with self.cond:
+            self._pending[:0] = list(requests)
+            self.cond.notify_all()
 
     def _expire_locked(self, now: float) -> list[ServeRequest]:
         """Fail-and-drop every pending request whose deadline passed."""

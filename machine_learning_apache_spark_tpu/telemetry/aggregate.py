@@ -276,9 +276,87 @@ def ingest_report(events: list[dict], table: dict | None = None) -> dict:
     }
 
 
+def serving_report(events: list[dict], table: dict | None = None) -> dict:
+    """Serving rollup for the gang report, from the ``serving.*`` event
+    family the engine emits:
+
+    - ``phases``: the ``serving.*`` rows of the phase table (submit and
+      batch/launch span durations);
+    - ``batches_by_mode``: span counts and mean duration split by the
+      ``mode`` attr ("padded" vs "paged") — a mixed-mode gang shows both;
+    - ``counters``: per-rank totals of ``serving.*`` counter events
+      (today: ``tokens_real``/``tokens_padded``, the padding-waste pair
+      ``ServingMetrics.on_token_slots`` mirrors into the event stream);
+    - ``padding_waste``: computed-slot waste across every rank, the
+      fraction of slots the compiled programs spent on padding;
+    - ``quarantines`` / ``rejects`` / ``expired``: containment and
+      admission annotations, summed.
+
+    Empty sub-dicts when the run served nothing — the renderer then
+    omits the section.
+    """
+    table = phase_table(events) if table is None else table
+    counters: dict[str, dict] = {}
+    by_mode: dict[str, dict] = {}
+    quarantines = rejects = expired = 0
+    for ev in events:
+        name = str(ev.get("name", ""))
+        if not name.startswith("serving."):
+            continue
+        kind = ev.get("kind")
+        attrs = ev.get("attrs") or {}
+        if kind == "counter":
+            per_rank = counters.setdefault(name, {})
+            entry = per_rank.setdefault(ev.get("rank"), {"total": 0.0})
+            entry["total"] += float(ev.get("value") or 0.0)
+        elif kind == "span_end" and name == "serving.batch":
+            mode = str(attrs.get("mode") or "padded")
+            entry = by_mode.setdefault(mode, {"count": 0, "total_s": 0.0})
+            entry["count"] += 1
+            entry["total_s"] += float(ev.get("value") or 0.0)
+        elif kind == "annotation":
+            if name == "serving.quarantine":
+                quarantines += 1
+            elif name == "serving.queue.reject":
+                rejects += 1
+            elif name == "serving.queue.expire":
+                expired += int(attrs.get("count") or 0)
+    for entry in by_mode.values():
+        entry["mean_s"] = (
+            round(entry["total_s"] / entry["count"], 6)
+            if entry["count"] else None
+        )
+        entry["total_s"] = round(entry["total_s"], 6)
+
+    def _sum(name: str) -> float:
+        return sum(
+            e["total"] for e in counters.get(name, {}).values()
+        )
+
+    real, padded = _sum("serving.tokens_real"), _sum("serving.tokens_padded")
+    return {
+        "phases": {
+            phase: entry
+            for phase, entry in table.items()
+            if phase.startswith("serving.")
+        },
+        "batches_by_mode": dict(sorted(by_mode.items())),
+        "counters": {
+            name: dict(sorted(
+                per_rank.items(), key=lambda kv: (kv[0] is None, kv[0])
+            ))
+            for name, per_rank in sorted(counters.items())
+        },
+        "padding_waste": round(1.0 - real / padded, 4) if padded else None,
+        "quarantines": quarantines,
+        "rejects": rejects,
+        "expired": expired,
+    }
+
+
 def merge_gang_dir(directory: str) -> dict:
     """One-call report over a gang workdir: find rank files, merge, build
-    the phase table, skew report, and comms rollup."""
+    the phase table, skew report, and the comms/ingest/serving rollups."""
     paths = find_rank_files(directory)
     events = merge_rank_files(paths)
     table = phase_table(events)
@@ -291,6 +369,7 @@ def merge_gang_dir(directory: str) -> dict:
         "skew": skew_report(table),
         "comms": comms_report(events, table),
         "ingest": ingest_report(events, table),
+        "serving": serving_report(events, table),
     }
 
 
@@ -416,6 +495,35 @@ def render_markdown(report: dict) -> str:
                     lines.append(
                         f"| {name} | {rank} | {int(entry['total'])} |"
                     )
+    serving = report.get("serving") or {}
+    if serving.get("batches_by_mode") or serving.get("counters"):
+        lines += ["", "## Serving", ""]
+        if serving.get("padding_waste") is not None:
+            lines.append(
+                f"- padding waste: **{serving['padding_waste']}** of "
+                "computed token slots"
+            )
+        for key in ("quarantines", "rejects", "expired"):
+            if serving.get(key):
+                lines.append(f"- {key}: {serving[key]}")
+        if serving.get("batches_by_mode"):
+            lines.append("")
+            lines.append("| kv mode | dispatches | mean (ms) | total (s) |")
+            lines.append("|---|---|---|---|")
+            for mode, entry in serving["batches_by_mode"].items():
+                lines.append(
+                    f"| {mode} | {entry['count']} "
+                    f"| {_fmt(entry['mean_s'])} | {entry['total_s']:.3f} |"
+                )
+        if serving.get("counters"):
+            lines.append("")
+            lines.append("| counter | rank | total |")
+            lines.append("|---|---|---|")
+            for name, per_rank in serving["counters"].items():
+                for rank, entry in per_rank.items():
+                    lines.append(
+                        f"| {name} | {rank} | {int(entry['total'])} |"
+                    )
     return "\n".join(lines) + "\n"
 
 
@@ -430,6 +538,7 @@ __all__ = [
     "phase_table",
     "rank_file_name",
     "render_markdown",
+    "serving_report",
     "skew_report",
     "write_rank_file",
 ]

@@ -410,3 +410,389 @@ class TestEngineE2E:
                 for f in [eng.submit(s) for s in short]
             ]
         assert outs == t(short, method="beam", beam_size=2, max_new_tokens=4)
+
+
+class TestKVPagePool:
+    def test_round_trip_never_hands_out_null_page(self):
+        from machine_learning_apache_spark_tpu.serving import (
+            NULL_PAGE,
+            KVPagePool,
+        )
+
+        pool = KVPagePool(8)
+        assert pool.capacity == 7
+        pages = pool.try_acquire(3, "a")
+        assert pages is not None and len(pages) == 3
+        assert NULL_PAGE not in pages
+        assert pool.in_use == 3 and pool.high_water == 3
+        assert pool.release_owner("a") == 3
+        assert pool.in_use == 0 and pool.free == 7
+        assert pool.total_acquired == 3 and pool.total_released == 3
+        # idempotent: an owner with no refs frees zero
+        assert pool.release_owner("a") == 0
+
+    def test_try_acquire_insufficient_returns_none(self):
+        from machine_learning_apache_spark_tpu.serving import KVPagePool
+
+        pool = KVPagePool(4)  # 3 allocatable
+        assert pool.try_acquire(4, "a") is None
+        assert pool.in_use == 0  # all-or-nothing: no partial grant
+
+    def test_refcounted_prefix_pages_survive_owner_release(self):
+        from machine_learning_apache_spark_tpu.serving import KVPagePool
+
+        pool = KVPagePool(8)
+        shared = pool.try_acquire(2, "req1")
+        pool.add_ref(shared, "req2")
+        assert all(pool.refcount(p) == 2 for p in shared)
+        # first holder leaves: pages must stay allocated for the second
+        assert pool.release_owner("req1") == 0
+        assert pool.in_use == 2
+        assert all(pool.refcount(p) == 1 for p in shared)
+        assert pool.release_owner("req2") == 2
+        assert pool.in_use == 0
+
+    def test_add_ref_rejects_unallocated_and_null(self):
+        from machine_learning_apache_spark_tpu.serving import (
+            NULL_PAGE,
+            KVPagePool,
+        )
+
+        pool = KVPagePool(8)
+        with pytest.raises(ValueError, match="not allocated"):
+            pool.add_ref([5], "x")
+        with pytest.raises(ValueError, match="not allocated"):
+            pool.add_ref([NULL_PAGE], "x")
+
+    def test_blocking_acquire_is_fifo_fair(self):
+        """A waiting all-or-nothing grant must not be starved by later
+        try_acquire calls skimming pages as they free."""
+        from machine_learning_apache_spark_tpu.serving import KVPagePool
+
+        pool = KVPagePool(4)  # 3 allocatable
+        pool.try_acquire(3, "hog")
+        got = []
+        waiter = threading.Thread(
+            target=lambda: got.append(pool.acquire(3, "first", timeout=10))
+        )
+        waiter.start()
+        deadline = time.monotonic() + 5
+        while not pool._tickets and time.monotonic() < deadline:
+            time.sleep(0.001)
+        # a later non-blocking grab yields to the queued waiter
+        assert pool.try_acquire(1, "sneak") is None
+        pool.release_owner("hog")
+        waiter.join(timeout=10)
+        assert got and got[0] is not None and len(got[0]) == 3
+        assert pool.pages_of("first") == got[0]
+
+    def test_acquire_validation(self):
+        from machine_learning_apache_spark_tpu.serving import KVPagePool
+
+        pool = KVPagePool(4)
+        with pytest.raises(ValueError, match="never fit"):
+            pool.acquire(4, "a")
+        with pytest.raises(ValueError, match=">= 0"):
+            pool.try_acquire(-1, "a")
+        pool.try_acquire(3, "hold")
+        assert pool.acquire(1, "b", timeout=0.01) is None  # times out
+
+
+class TestPrefixCache:
+    def _mk(self, num_pages=16, capacity=4):
+        from machine_learning_apache_spark_tpu.serving import (
+            KVPagePool,
+            PrefixCache,
+        )
+
+        pool = KVPagePool(num_pages)
+        return pool, PrefixCache(pool, capacity)
+
+    def test_hit_attaches_requester_ref(self):
+        pool, cache = self._mk()
+        pages = pool.try_acquire(2, "req1")
+        assert cache.put((1, 2, 3), pages, width=8)
+        pool.release_owner("req1")
+        # cache ref keeps the prefix alive after the prefiller left
+        assert pool.in_use == 2
+        entry = cache.get((1, 2, 3), owner="req2")
+        assert entry is not None and entry["pages"] == pages
+        assert entry["width"] == 8
+        assert all(pool.refcount(p) == 2 for p in pages)
+        assert cache.stats()["hits"] == 1
+        assert cache.get((9,), owner="req3") is None
+        assert cache.stats()["misses"] == 1
+
+    def test_eviction_frees_only_unreferenced_pages(self):
+        pool, cache = self._mk(capacity=1)
+        a = pool.try_acquire(1, "r1")
+        cache.put(("a",), a)
+        cache.get(("a",), owner="r1-decode")  # a live request attaches
+        b = pool.try_acquire(1, "r2")
+        cache.put(("b",), b)  # capacity 1: evicts ("a",)
+        assert len(cache) == 1 and cache.stats()["evictions"] == 1
+        # evicted entry's page survives until every holder releases
+        assert pool.refcount(a[0]) >= 1
+        pool.release_owner("r1")
+        pool.release_owner("r1-decode")
+        assert pool.refcount(a[0]) == 0
+
+    def test_evict_until_free_pressure_valve(self):
+        pool, cache = self._mk(num_pages=6, capacity=8)  # 5 allocatable
+        for key in ("a", "b", "c"):
+            pages = pool.try_acquire(1, key)
+            cache.put((key,), pages)
+            pool.release_owner(key)
+        assert pool.free == 2
+        cache.evict_until_free(4)
+        assert pool.free >= 4
+        assert len(cache) == 1  # LRU shed, newest survives
+
+    def test_flush_drops_everything(self):
+        pool, cache = self._mk()
+        for key in ("a", "b"):
+            pages = pool.try_acquire(1, key)
+            cache.put((key,), pages)
+            pool.release_owner(key)
+        assert cache.flush() == 2
+        assert len(cache) == 0 and pool.in_use == 0
+
+    def test_zero_capacity_disables(self):
+        pool, cache = self._mk(capacity=0)
+        pages = pool.try_acquire(1, "r")
+        assert cache.put(("a",), pages) is False
+        pool.release_owner("r")
+        assert pool.in_use == 0  # no silent cache ref was taken
+
+    def test_contains_is_side_effect_free(self):
+        pool, cache = self._mk()
+        pages = pool.try_acquire(1, "r")
+        cache.put(("a",), pages)
+        pool.release_owner("r")
+        before = cache.stats()
+        assert cache.contains(("a",)) is True
+        assert cache.contains(("nope",)) is False
+        after = cache.stats()
+        # no hit/miss accounting, no LRU bump, no reference attached
+        assert after == before
+        assert all(pool.refcount(p) == 1 for p in pages)
+
+
+class TestTokenBudgetBatcher:
+    def _mk(self, chunk=4, clock=None):
+        from machine_learning_apache_spark_tpu.serving import (
+            TokenBudgetBatcher,
+        )
+
+        q = RequestQueue(max_depth=64, clock=clock or time.monotonic)
+        return q, TokenBudgetBatcher(q, chunk=chunk)
+
+    def test_cost_rounds_to_chunk_grid(self):
+        _, b = self._mk(chunk=4)
+        assert b.cost([1]) == 4
+        assert b.cost([1, 2, 3, 4]) == 4
+        assert b.cost([1] * 5) == 8
+        assert b.cost([]) == 4  # empty prompt still costs one chunk
+
+    def test_fifo_prefix_under_budget(self):
+        q, b = self._mk(chunk=4)
+        q.submit("long", list(range(10)))  # cost 12
+        q.submit("s1", [1, 2, 3])  # cost 4
+        q.submit("s2", [4, 5, 6])  # cost 4
+        taken = b.take(max_requests=8, token_budget=16)
+        assert [r.text for r in taken] == ["long", "s1"]
+        # never skips the big head in favour of cheap ones behind it
+        taken = b.take(max_requests=8, token_budget=16)
+        assert [r.text for r in taken] == ["s2"]
+
+    def test_head_always_granted(self):
+        q, b = self._mk(chunk=4)
+        q.submit("huge", list(range(12)))  # cost 12 > budget
+        taken = b.take(max_requests=8, token_budget=4)
+        assert [r.text for r in taken] == ["huge"]
+
+    def test_max_requests_and_empty_timeout(self):
+        q, b = self._mk()
+        q.submit("a", [1])
+        q.submit("b", [2])
+        assert b.take(max_requests=0, token_budget=100) == []
+        taken = b.take(max_requests=1, token_budget=100)
+        assert [r.text for r in taken] == ["a"]
+        b.take(max_requests=8, token_budget=100)  # drains "b"
+        t0 = time.monotonic()
+        assert b.take(max_requests=8, token_budget=100, timeout=0.05) == []
+        assert time.monotonic() - t0 < 2.0
+
+    def test_cost_fn_override_prices_admission(self):
+        # The engine prices prefix-cache hits at zero: a budget that
+        # admits one cold prompt admits any number of cached ones.
+        q, b = self._mk(chunk=4)
+        for i in range(4):
+            q.submit(f"hit{i}", [i])  # default cost 4 each
+        q.submit("miss", list(range(6)))  # cost 8
+        taken = b.take(
+            max_requests=8, token_budget=8,
+            cost_fn=lambda r: 0 if r.text.startswith("hit") else 8,
+        )
+        assert [r.text for r in taken] == [
+            "hit0", "hit1", "hit2", "hit3", "miss"
+        ]
+        # default pricing would have stopped after two chunk-4 prompts
+        q2, b2 = self._mk(chunk=4)
+        for i in range(4):
+            q2.submit(f"hit{i}", [i])
+        taken = b2.take(max_requests=8, token_budget=8)
+        assert len(taken) == 2
+
+    def test_expired_swept_not_taken(self):
+        clock = FakeClock()
+        q, b = self._mk(clock=clock)
+        dead = q.submit("dead", [1], deadline_s=1.0)
+        clock.advance(2.0)
+        q.submit("live", [2], deadline_s=10.0)
+        taken = b.take(max_requests=8, token_budget=100)
+        assert [r.text for r in taken] == ["live"]
+        with pytest.raises(DeadlineExceeded):
+            dead.result(timeout=0)
+
+
+class TestKVSlotPoolFairness:
+    def test_blocked_batch_not_starved_by_try_acquire(self):
+        pool = KVSlotPool(2)
+        pool.try_acquire(100)
+        pool.try_acquire(101)
+        got = []
+        waiter = threading.Thread(
+            target=lambda: got.append(
+                pool.acquire_many([200, 201], timeout=10)
+            )
+        )
+        waiter.start()
+        deadline = time.monotonic() + 5
+        while not pool._tickets and time.monotonic() < deadline:
+            time.sleep(0.001)
+        pool.release_owner(100)
+        # one slot free, but it belongs to the queued batch — a latecomer
+        # must not skim it
+        assert pool.try_acquire(300) is None
+        pool.release_owner(101)
+        waiter.join(timeout=10)
+        assert got and got[0] is not None and len(got[0]) == 2
+        assert pool.in_use == 2
+
+
+class TestPagedEngine:
+    def test_kv_mode_validation_and_env_override(self, tiny_translator):
+        t, _ = tiny_translator
+        with pytest.raises(ValueError, match="kv_mode"):
+            t.serve(boundaries=(8,), max_batch=2, kv_mode="ragged",
+                    start=False)
+        import os
+
+        os.environ["MLSPARK_SERVE_KV_MODE"] = "padded"
+        try:
+            eng = t.serve(boundaries=(8,), max_batch=2, start=False)
+            assert eng.kv_mode == "padded" and eng.runtime is None
+        finally:
+            del os.environ["MLSPARK_SERVE_KV_MODE"]
+        # explicit argument beats the env contract
+        eng = t.serve(boundaries=(8,), max_batch=2, kv_mode="paged",
+                      start=False)
+        assert eng.kv_mode == "paged" and eng.runtime is not None
+
+    def test_padded_mode_still_matches_oneshot(self, tiny_translator):
+        """The legacy rectangle path stays selectable and correct — it is
+        the parity oracle the paged path is measured against."""
+        t, texts = tiny_translator
+        texts = texts[:8]
+        with t.serve(
+            boundaries=(8, 16), max_batch=4, max_wait_s=0.01,
+            max_new_tokens=8, kv_mode="padded",
+        ) as eng:
+            outs = [f.result(timeout=120) for f in
+                    [eng.submit(s) for s in texts]]
+            assert eng.recompiles_after_warmup == 0
+        assert outs == t(texts, max_new_tokens=8)
+
+    def test_zero_recompiles_across_ragged_occupancies(self, tiny_translator):
+        """The paged tentpole invariant: after warmup, every wave shape —
+        occupancy 1..max_active, short and long prompts interleaved,
+        repeat prompts hitting the prefix cache — runs the same compiled
+        programs."""
+        t, texts = tiny_translator
+        short = [s for s in texts if len(s.split()) <= 5]
+        long_ = [s for s in texts if len(s.split()) >= 7]
+        with t.serve(
+            boundaries=(8, 16), max_batch=4, max_wait_s=0.01,
+            max_new_tokens=8, kv_mode="paged",
+        ) as eng:
+            waves = [
+                short[:1],                  # single row
+                long_[:3],                  # partial, long prompts
+                short[:2] + long_[3:5],     # full, mixed lengths
+                short[:1],                  # repeat: prefix-cache hit
+            ]
+            expect = []
+            for wave in waves:
+                outs = [f.result(timeout=120) for f in
+                        [eng.submit(s) for s in wave]]
+                expect.append((wave, outs))
+            assert eng.recompiles_after_warmup == 0
+            assert eng.runtime.mem_pool.in_use >= 0
+            m = eng.metrics
+            assert 0 < m.real_tokens <= m.padded_tokens
+            assert 0.0 <= m.padding_waste < 1.0
+            stats = eng.runtime.stats()
+            assert stats["prefix_cache"]["hits"] >= 1
+            eng.metrics.check_conservation(in_flight=0)
+        for wave, outs in expect:
+            assert outs == t(wave, max_new_tokens=8)
+
+    def test_paged_pages_freed_on_completion(self, tiny_translator):
+        t, texts = tiny_translator
+        with t.serve(
+            boundaries=(8, 16), max_batch=4, max_new_tokens=8,
+            kv_mode="paged", prefix_cache_size=0,
+        ) as eng:
+            [f.result(timeout=120) for f in
+             [eng.submit(s) for s in texts[:8]]]
+            assert eng.pool.in_use == 0  # decode rows
+            # no prefix cache: every request's pages fully returned
+            assert eng.runtime.mem_pool.in_use == 0
+            assert eng.runtime.self_pool.in_use == 0
+
+
+def test_serve_bench_smoke_subprocess(tmp_path):
+    """tools/serve_bench.py --smoke is the tier-1 CI entry: fresh
+    process, padded-vs-paged parity gate, and a short paged sweep with
+    the zero-recompile and conservation gates."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "serve_bench.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo_root, "tools", "serve_bench.py"),
+            "--smoke", "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    art = json.loads(out.read_text())
+    assert art["ok"] is True
+    assert art["gates"] == {
+        "parity": True,
+        "zero_recompiles": True,
+        "conservation": True,
+    }
+    assert art["parity"]["identical"] is True
+    rows = art["modes"]["paged"]["rows"]
+    assert rows and all(row["completed"] > 0 for row in rows)
+    summary = art["modes"]["paged"]["engine_summary"]
+    assert summary["padding_waste"] is not None
+    assert art["modes"]["paged"]["paged_runtime"]["prefix_cache"]["hits"] > 0
