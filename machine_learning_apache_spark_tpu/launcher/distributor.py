@@ -151,6 +151,7 @@ class Distributor:
         env: dict[str, str] | None = None,
         dp_mode: str | None = None,
         serve_kv_mode: str | None = None,
+        telemetry_http: int | None = None,
         ingest: dict | None = None,
         timeout: float = 600.0,
         max_restarts: int = 0,
@@ -190,6 +191,20 @@ class Distributor:
                 "'padded' or 'paged')"
             )
         self.serve_kv_mode = serve_kv_mode
+        # Live observability plane, same env-contract shape: the knob
+        # becomes MLSPARK_TELEMETRY_HTTP in every worker, which runner.main
+        # resolves into a per-rank HTTP server. 0 means "ephemeral port per
+        # rank" (the only sane choice for a local gang — fixed ports would
+        # collide); each rank publishes its bound port in an
+        # http_rank<k>.json sidecar for tools/gang_status.py to find.
+        if telemetry_http is not None and not (
+            0 <= int(telemetry_http) <= 65535
+        ):
+            raise ValueError(
+                f"telemetry_http must be a port in [0, 65535] or None, "
+                f"got {telemetry_http!r}"
+            )
+        self.telemetry_http = telemetry_http
         # Input-pipeline plumbing, same shape as dp_mode: the
         # Distributor(ingest={"buffer": 4, "tail": "pad", ...}) knob
         # becomes MLSPARK_INGEST_* in every worker's environment (the
@@ -392,6 +407,9 @@ class Distributor:
             # inherited env; explicit env= still wins below).
             if self.serve_kv_mode is not None:
                 env["MLSPARK_SERVE_KV_MODE"] = self.serve_kv_mode
+            # Observability-plane port knob, same contract shape.
+            if self.telemetry_http is not None:
+                env["MLSPARK_TELEMETRY_HTTP"] = str(self.telemetry_http)
             # Ingest knobs ride the same contract: constructor > inherited
             # env (explicit env= still wins below).
             env.update(self.ingest_env)

@@ -26,6 +26,7 @@ the result files' tracebacks attached.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -36,6 +37,19 @@ from machine_learning_apache_spark_tpu import telemetry
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+def read_heartbeat(path: str) -> dict:
+    """Parse one heartbeat file's JSON payload (rank, pid, phase, step,
+    http_port — written by ``runner._start_heartbeat``). Returns ``{}``
+    for legacy empty-touch beats, torn writes, or unreadable files: the
+    payload is enrichment, the mtime is the liveness contract."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return payload if isinstance(payload, dict) else {}
 
 
 class GangFailure(RuntimeError):
@@ -173,9 +187,23 @@ class GangMonitor(threading.Thread):
             for rank in sorted(pending):
                 silent = now - self._last_beat(rank)
                 if silent > self.heartbeat_timeout:
+                    # The stalled rank's last payload says what it was
+                    # doing when it went quiet — the first question any
+                    # postmortem asks.
+                    last = (
+                        read_heartbeat(self.heartbeat_paths[rank])
+                        if rank < len(self.heartbeat_paths) else {}
+                    )
+                    where = ""
+                    if last.get("phase") is not None:
+                        where = f" (last phase {last['phase']!r}"
+                        if last.get("step") is not None:
+                            where += f", step {last['step']}"
+                        where += ")"
                     return GangFailure(
                         f"rank {rank} missed heartbeats for {silent:.1f}s "
-                        f"(timeout {self.heartbeat_timeout}s) — stalled",
+                        f"(timeout {self.heartbeat_timeout}s) — "
+                        f"stalled{where}",
                         rank=rank, cause="heartbeat",
                     )
         if now > self.deadline:
@@ -218,4 +246,4 @@ class GangMonitor(threading.Thread):
                 time.sleep(self.poll_interval)
 
 
-__all__ = ["GangFailure", "GangMonitor", "terminate_gang"]
+__all__ = ["GangFailure", "GangMonitor", "read_heartbeat", "terminate_gang"]

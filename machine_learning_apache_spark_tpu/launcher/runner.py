@@ -8,16 +8,24 @@ framework import happens only after the rendezvous env is in place.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import pickle
 import sys
 import threading
+import time
 import traceback
 
 
-def _start_heartbeat(path: str, interval: float) -> threading.Thread:
-    """Touch ``path`` every ``interval`` seconds from a daemon thread —
-    the liveness signal ``launcher.monitor.GangMonitor`` watches.
+def _start_heartbeat(
+    path: str, interval: float, rank: int = 0
+) -> threading.Thread:
+    """Rewrite ``path`` every ``interval`` seconds from a daemon thread —
+    the liveness signal ``launcher.monitor.GangMonitor`` watches (by
+    mtime) and, since each beat is now a JSON payload (rank, pid, phase,
+    step, http_port), also the gang-status signal ``tools/gang_status.py``
+    reads for content. Atomic tmp+replace so a reader never sees a torn
+    beat; the mtime contract is unchanged, so old monitors keep working.
 
     Started before the heavy framework imports so a wedged import counts
     as the stall it is only after the full ``heartbeat_timeout``, not as
@@ -35,14 +43,40 @@ def _start_heartbeat(path: str, interval: float) -> threading.Thread:
         mod = sys.modules.get("machine_learning_apache_spark_tpu.utils.faults")
         return bool(mod is not None and mod.heartbeats_suspended())
 
-    def beat() -> None:
-        import time
+    def beacon() -> dict:
+        # Same peek discipline for the telemetry beacon (phase, step,
+        # http_port): events.py is stdlib-only but sits under the heavy
+        # package __init__, so this thread must not import it. Before the
+        # worker's framework import, the module is absent and the beat
+        # carries liveness only.
+        mod = sys.modules.get(
+            "machine_learning_apache_spark_tpu.telemetry.events"
+        )
+        if mod is None:
+            return {}
+        try:
+            return mod.beacon()
+        except Exception:
+            return {}
 
+    def beat() -> None:
         while True:
             if not suspended():
+                b = beacon()
+                payload = {
+                    "rank": rank,
+                    "pid": os.getpid(),
+                    "wall": round(time.time(), 3),
+                    "phase": b.get("phase"),
+                    "step": b.get("step"),
+                    "http_port": b.get("http_port"),
+                }
+                tmp = f"{path}.tmp.{os.getpid()}"
                 try:
-                    with open(path, "a"):
-                        os.utime(path)
+                    with open(tmp, "w") as f:
+                        json.dump(payload, f)
+                        f.write("\n")
+                    os.replace(tmp, path)
                 except OSError:
                     pass  # workdir tearing down — the gang is over anyway
             time.sleep(interval)
@@ -104,6 +138,7 @@ def main(argv: list[str] | None = None) -> int:
         _start_heartbeat(
             heartbeat_file,
             float(os.environ.get("MLSPARK_HEARTBEAT_INTERVAL", "1.0")),
+            rank=rank,
         )
 
     args, kwargs = ((), {})
@@ -130,6 +165,12 @@ def main(argv: list[str] | None = None) -> int:
         from machine_learning_apache_spark_tpu import telemetry as tm
 
         _install_sigterm_flight(tm, rank)
+
+        # Live observability plane: start this rank's HTTP server (no-op
+        # with zero threads unless MLSPARK_TELEMETRY_HTTP is set) and seed
+        # the beacon so the very next heartbeat carries phase + http_port.
+        tm.beacon_update(phase="startup")
+        tm.start_http_server(rank=rank)
 
         # Record the gang's data-parallel update contract on this rank's
         # timeline (MLSPARK_DP_MODE / bucket / comms-dtype — set by

@@ -94,6 +94,8 @@ class Batcher:
             if len(members) >= self.max_batch:
                 chosen = members[: self.max_batch]
                 self.queue.take_locked(chosen)
+                for r in chosen:
+                    r.trace.mark("batched", now, bucket=b, full=True)
                 return Batch(b, self.boundaries[b], chosen)
         # Head-of-line overdue → ship its bucket, partial.
         oldest = pending[0]
@@ -106,6 +108,8 @@ class Batcher:
             b = max(overdue_buckets, key=lambda k: len(groups[k]))
             chosen = groups[b][: self.max_batch]
             self.queue.take_locked(chosen)
+            for r in chosen:
+                r.trace.mark("batched", now, bucket=b, full=False)
             return Batch(b, self.boundaries[b], chosen)
         return None
 
@@ -218,6 +222,8 @@ class TokenBudgetBatcher:
                         chosen.append(r)
                         spent += c
                     self.queue.take_locked(chosen)
+                    for r in chosen:
+                        r.trace.mark("batched", now, budget_spent=spent)
                     return chosen
                 remaining = give_up - now
                 if remaining <= 0:

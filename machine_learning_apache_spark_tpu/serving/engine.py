@@ -213,6 +213,13 @@ class ServingEngine:
         # ``decode_batch`` fault-injection coordinate (worker thread
         # only; no lock needed).
         self._batch_seq = 0
+        # Health model for /healthz: the engine is DEGRADED while its most
+        # recent quarantine is more recent than its most recent successful
+        # batch — i.e. it has contained a fault and not yet proven it can
+        # decode again. Worker-thread writes, scrape-thread reads; float
+        # stores are atomic enough for a monotonic comparison.
+        self._last_quarantine_t: float | None = None
+        self._last_ok_batch_t: float | None = None
 
     def _make_decoder(self, beam_size: int, length_penalty: float):
         """One jitted decode callable (its own jit cache → per-bucket
@@ -246,11 +253,32 @@ class ServingEngine:
             target=self._serve_loop, name="serving-engine", daemon=True
         )
         self._worker.start()
+        # The live plane: contribute this engine's state to /statusz,
+        # /healthz, and /metrics, and (idempotently) start the HTTP
+        # server — a no-op with zero threads unless MLSPARK_TELEMETRY_HTTP
+        # is set and telemetry is on.
+        telemetry.register_status_provider("serving", self._status_snapshot)
+        telemetry.register_health_provider("serving", self._health_snapshot)
+        telemetry.register_live_gauge(
+            "serving", "queue_depth_live", lambda: self.queue.depth
+        )
+        if self.runtime is not None:
+            telemetry.register_live_gauge(
+                "serving", "kv_page_occupancy",
+                lambda: self.runtime.mem_pool.occupancy,
+            )
+            telemetry.register_live_gauge(
+                "serving", "active_rows",
+                lambda: self.runtime.active_count(),
+            )
+        telemetry.start_http_server()
+        telemetry.beacon_update(phase="serving")
         return self
 
     def stop(self, *, timeout: float = 30.0) -> None:
         if self._worker is None:
             return
+        telemetry.unregister_provider("serving")
         self._stop.set()
         with self.queue.cond:
             self.queue.cond.notify_all()
@@ -326,6 +354,45 @@ class ServingEngine:
         if n is None or self._compiles_at_warmup is None:
             return None
         return n - self._compiles_at_warmup
+
+    # -- live plane providers (called from HTTP scrape threads) --------------
+    def _health_snapshot(self) -> dict:
+        """/healthz check: worker thread alive, and not in the degraded
+        window between a quarantine and the next successful batch."""
+        worker = self._worker
+        worker_alive = worker is not None and worker.is_alive()
+        lq, lok = self._last_quarantine_t, self._last_ok_batch_t
+        recovered = lq is None or (lok is not None and lok > lq)
+        return {
+            "healthy": worker_alive and recovered,
+            "worker_alive": worker_alive,
+            "quarantine_recovered": recovered,
+            "kv_mode": self.kv_mode,
+            "queue_depth": self.queue.depth,
+            "loop_restarts": self.metrics.loop_restarts,
+            "quarantined": self.metrics.quarantined,
+        }
+
+    def _status_snapshot(self) -> dict:
+        """/statusz section: the engine's full live state — config,
+        conservation ledger, latency summary, page-pool stats, slowest-
+        request exemplars."""
+        out = {
+            "kv_mode": self.kv_mode,
+            "method": self.method,
+            "boundaries": list(self.boundaries),
+            "max_batch": self.max_batch,
+            "max_active": self.max_active,
+            "max_new_tokens": self.max_new_tokens,
+            "queue_depth": self.queue.depth,
+            "recompiles_after_warmup": self.recompiles_after_warmup,
+            "ledger": self.metrics.ledger(),
+            "metrics": self.metrics.summary(),
+            "slowest_requests": self.metrics.request_exemplars(),
+        }
+        if self.runtime is not None:
+            out["page_pool"] = self.runtime.stats()
+        return out
 
     # -- request path --------------------------------------------------------
     @property
@@ -452,6 +519,10 @@ class ServingEngine:
                 return
             kind, computed, real = res
             req.admit_time = self.clock()
+            req.trace.mark(
+                "admit", req.admit_time,
+                kind=kind, prefill_tokens=computed, row=row,
+            )
             self.metrics.on_token_slots(
                 real=0 if kind == "hit" else real, padded=computed
             )
@@ -466,29 +537,37 @@ class ServingEngine:
             req = self.runtime.retire(row)
             self.pool.release_owner(req.id)
             if not req.future.done():
+                req.trace.mark("failed", self.clock(), reason="pages_exhausted")
                 req.future.set_exception(InternalError(
                     "kv page pool exhausted mid-decode; size num_pages "
                     "for the worst case (the default does)"
                 ))
                 self.metrics.on_failure(1)
-        n_active = self.runtime.active_count()
+                self.metrics.on_trace(req)
+        active = self.runtime.active_requests()
+        n_active = len(active)
         if n_active == 0:
             return
         t0 = self.clock()
         with telemetry.span(
             "serving.batch", mode="paged", rows=n_active,
             steps=self.runtime.steps_per_launch,
+            requests=[r.trace.trace_id for r in active],
         ), annotate("serve_decode_paged"):
             result = self.runtime.launch()
         decode_done = self.clock()
         decode_s = decode_done - t0
+        for req in active:
+            req.trace.note_launch()
         for req in result.first_emits:
             req.decode_done_time = decode_done
+            req.trace.mark("first_token", decode_done)
         vocab = self.translator.trg_pipe.vocab
         n_completed = 0
         for req, ids, row, saw_eos in result.completed:
             self.runtime.retire(row)
             self.pool.release_owner(req.id)
+            req.trace.mark("complete", decode_done, tokens=len(ids))
             req.future.set_result(" ".join(vocab.lookup_tokens(ids)))
             n_completed += 1
             now = self.clock()
@@ -498,6 +577,7 @@ class ServingEngine:
                 ttft=(req.decode_done_time or now) - req.submit_time,
                 total=now - req.submit_time,
             )
+            self.metrics.on_trace(req)
         # Token ledger parity with the padded path (len(content)+1 per
         # request): real emits count EOS when emitted; a budget-exhausted
         # row gets its implicit stop token here.
@@ -517,6 +597,9 @@ class ServingEngine:
             queue_depth=self.queue.depth,
             slot_occupancy=self.runtime.mem_pool.occupancy,
         )
+        # A launch completed without raising: the degraded window (if
+        # any) is over — /healthz flips back to ok.
+        self._last_ok_batch_t = decode_done
 
     def _paged_quarantine(self, exc: Exception) -> None:
         """Contain a failed launch/admission: the page store's contents
@@ -525,6 +608,7 @@ class ServingEngine:
         still queued keeps flowing."""
         if self._stop.is_set():
             return
+        self._last_quarantine_t = self.clock()
         active = self.runtime.reset()
         log.info("quarantining paged launch of %d: %r", len(active), exc)
         telemetry.annotate(
@@ -532,9 +616,14 @@ class ServingEngine:
             error=type(exc).__name__,
         )
         n = 0
+        traces = []
         for req in active:
             self.pool.release_owner(req.id)
             if not req.future.done():
+                req.trace.mark(
+                    "failed", self.clock(), reason="quarantine",
+                    error=type(exc).__name__,
+                )
                 err = InternalError(
                     f"decode batch failed internally ({type(exc).__name__});"
                     " only the active paged rows are affected"
@@ -542,11 +631,19 @@ class ServingEngine:
                 err.__cause__ = exc
                 req.future.set_exception(err)
                 n += 1
+                traces.append(req.trace.to_dict())
+                self.metrics.on_trace(req)
         self.metrics.on_quarantine(n)
         self.metrics.on_failure(n)
+        # The flight dump carries each quarantined request's full trace
+        # timeline — postmortems see where every victim's time went, not
+        # just how many there were.
         telemetry.dump_flight(
             f"serving.quarantine:{type(exc).__name__}",
-            extra={"mode": "paged", "requests_failed": n},
+            extra={
+                "mode": "paged", "requests_failed": n,
+                "request_traces": traces,
+            },
         )
 
     def _paged_fail_active(self, exc: Exception) -> None:
@@ -556,6 +653,7 @@ class ServingEngine:
         for req in self.runtime.reset():
             self.pool.release_owner(req.id)
             if not req.future.done():
+                req.trace.mark("failed", self.clock(), reason="engine_stop")
                 req.future.set_exception(exc)
                 n += 1
         if n:
@@ -565,6 +663,7 @@ class ServingEngine:
     def _quarantine(self, batch: Batch, exc: Exception) -> None:
         """Contain one failed batch: free its KV slots, fail its (and only
         its) requests with ``InternalError``, and count it."""
+        self._last_quarantine_t = self.clock()
         log.info("quarantining batch of %d: %r", len(batch.requests), exc)
         telemetry.annotate(
             "serving.quarantine",
@@ -572,9 +671,14 @@ class ServingEngine:
             error=type(exc).__name__,
         )
         n = 0
+        traces = []
         for r in batch.requests:
             self.pool.release_owner(r.id)
             if not r.future.done():
+                r.trace.mark(
+                    "failed", self.clock(), reason="quarantine",
+                    error=type(exc).__name__,
+                )
                 err = InternalError(
                     f"decode batch failed internally ({type(exc).__name__}); "
                     "only this batch's requests are affected"
@@ -582,13 +686,18 @@ class ServingEngine:
                 err.__cause__ = exc
                 r.future.set_exception(err)
                 n += 1
+                traces.append(r.trace.to_dict())
+                self.metrics.on_trace(r)
         self.metrics.on_quarantine(n)
         self.metrics.on_failure(n)
-        # Flight recorder: the quarantined batch's decode span (errored) and
-        # the annotation above are the newest events in the dump.
+        # Flight recorder: the quarantined batch's decode span (errored),
+        # the annotation above, and every victim's trace timeline.
         telemetry.dump_flight(
             f"serving.quarantine:{type(exc).__name__}",
-            extra={"boundary": batch.boundary, "requests_failed": n},
+            extra={
+                "boundary": batch.boundary, "requests_failed": n,
+                "request_traces": traces,
+            },
         )
 
     def _take_slots(self, batch: Batch) -> list[ServeRequest]:
@@ -601,6 +710,7 @@ class ServingEngine:
             for r in members:
                 if r not in live:
                     self.metrics.on_expire()
+                    r.trace.mark("expire", now, where="slot_wait")
                     r.future.set_exception(
                         DeadlineExceeded(
                             f"request {r.id} expired awaiting a KV slot"
@@ -614,6 +724,7 @@ class ServingEngine:
         n_failed = 0
         for r in members:  # engine stopping
             if not r.future.done():
+                r.trace.mark("failed", self.clock(), reason="engine_stop")
                 r.future.set_exception(EngineStopped("engine stopping"))
                 n_failed += 1
         if n_failed:
@@ -624,6 +735,7 @@ class ServingEngine:
         with telemetry.span(
             "serving.batch", mode="padded", boundary=batch.boundary,
             size=len(batch.requests),
+            requests=[r.trace.trace_id for r in batch.requests],
         ):
             self._run_batch_inner(batch)
 
@@ -637,6 +749,11 @@ class ServingEngine:
         self._batch_seq += 1
         maybe_fault("decode_batch", batch=seq)
         batch_start = self.clock()
+        for r in members:
+            r.trace.mark(
+                "admit", batch_start,
+                kind="padded", prefill_tokens=batch.boundary,
+            )
         src = np.full((self.max_batch, batch.boundary), self._pad_id, np.int32)
         for i, r in enumerate(members):
             row = r.ids[: batch.boundary]
@@ -666,12 +783,15 @@ class ServingEngine:
         real_decode = 0
         for r, row in zip(members, rows):
             r.decode_done_time = decode_done
+            r.trace.note_launch()
+            r.trace.mark("first_token", decode_done)
             new_tokens += len(row) + 1  # emitted ids + the eos/stop token
             real_decode += min(len(row) + 1, self.max_new_tokens)
             text = " ".join(vocab.lookup_tokens(row))
             # Slot frees at EOS — the row is done generating either way
             # (eos emitted, or the max_new_tokens budget is exhausted).
             self.pool.release_owner(r.id)
+            r.trace.mark("complete", decode_done, tokens=len(row))
             r.future.set_result(text)
             done = self.clock()
             self.metrics.on_complete(
@@ -679,6 +799,7 @@ class ServingEngine:
                 ttft=decode_done - r.submit_time,
                 total=done - r.submit_time,
             )
+            self.metrics.on_trace(r)
         # Padding-waste ledger: the rectangle this batch computed (every
         # row, filler included, at full boundary/budget width) versus the
         # tokens that were real.
@@ -697,3 +818,5 @@ class ServingEngine:
             queue_depth=self.queue.depth,
             slot_occupancy=self.pool.occupancy,
         )
+        # Batch retired cleanly: end of any post-quarantine degraded window.
+        self._last_ok_batch_t = decode_done

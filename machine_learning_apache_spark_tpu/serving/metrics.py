@@ -38,6 +38,11 @@ from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
+#: How many slowest-request trace exemplars the ledger retains for
+#: /statusz. Small on purpose: exemplars are a debugging entry point
+#: ("which request was slow and where did its time go"), not a log.
+_MAX_EXEMPLARS = 8
+
 
 class ConservationError(AssertionError):
     """The serving admission ledger does not balance — a request was
@@ -135,6 +140,9 @@ class ServingMetrics:
         self.batch_occupancy = Histogram("batch_occupancy")
         self.slot_occupancy = Histogram("slot_occupancy")
         self.queue_depth = Histogram("queue_depth")
+        # slowest-request trace exemplars: list of (total_s, trace dict),
+        # kept sorted slowest-first, capped at _MAX_EXEMPLARS.
+        self._exemplars: list[tuple[float, dict]] = []
         # Mirror the admission counters into the process-global telemetry
         # registry (no-op singletons when MLSPARK_TELEMETRY=0). The registry
         # is cumulative across engines in one process — the Prometheus view;
@@ -232,6 +240,54 @@ class ServingMetrics:
         self.queue_wait.record(queue_wait)
         self.ttft.record(ttft)
         self.total_latency.record(total)
+
+    def on_trace(self, req) -> None:
+        """Fold one retired request's trace into the ledger: keep it if it
+        is among the slowest seen (the /statusz exemplars), and mirror its
+        latency breakdown into the event stream as a ``serving.request``
+        annotation so gang-level reports can aggregate request latency
+        across ranks from merged rank files."""
+        trace = getattr(req, "trace", None)
+        if trace is None:
+            return
+        bd = trace.breakdown()
+        total = bd.get("total_s")
+        if total is None:
+            return
+        with self._lock:
+            self._exemplars.append((total, trace.to_dict()))
+            self._exemplars.sort(key=lambda e: e[0], reverse=True)
+            del self._exemplars[_MAX_EXEMPLARS:]
+        if telemetry_events.enabled():
+            telemetry_events.get_log().emit(
+                "annotation", "serving.request", value=total, attrs=bd
+            )
+
+    def request_exemplars(self) -> list[dict]:
+        """The slowest retired requests' trace dicts, slowest first."""
+        with self._lock:
+            return [dict(t) for _, t in self._exemplars]
+
+    def ledger(self) -> dict:
+        """One atomic read of the admission counters plus the derived
+        ``in_flight`` — the /statusz view of the conservation law. Taken
+        under the ledger lock so the equality holds even when scraped
+        mid-decode (no counter can move between the reads)."""
+        with self._lock:
+            out = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "failed": self.failed,
+                "quarantined": self.quarantined,
+                "loop_restarts": self.loop_restarts,
+            }
+        out["in_flight"] = (
+            out["submitted"] - out["completed"] - out["rejected"]
+            - out["expired"] - out["failed"]
+        )
+        return out
 
     # -- invariants ----------------------------------------------------------
     def check_conservation(self, *, in_flight: int = 0) -> dict:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -29,6 +30,93 @@ from typing import Sequence
 from machine_learning_apache_spark_tpu.telemetry import events as telemetry_events
 
 _REQUEST_IDS = itertools.count()
+_TRACE_IDS = itertools.count()
+
+
+def _new_trace_id() -> str:
+    """Process-unique, gang-disambiguated request identity: the id a batch
+    span records, a flight dump carries, and /statusz exemplars key on."""
+    rank = telemetry_events._env_rank()
+    prefix = f"r{rank}-" if rank is not None else ""
+    return f"{prefix}{os.getpid():x}-{next(_TRACE_IDS):x}"
+
+
+class RequestTrace:
+    """One request's stitched timeline across threads: submit (caller) →
+    batch/admit (worker) → first token → retire, as ``(name, t, attrs)``
+    marks on the monotonic clock, plus a decode-launch counter (launches
+    are counted, not itemized — a long generation spans dozens).
+
+    Deliberately lock-free: marks are appended by one thread at a time
+    (the request moves queue → worker, never concurrently), and readers
+    (``/statusz`` exemplars, flight dumps) copy the append-only list.
+    """
+
+    __slots__ = ("trace_id", "marks", "launches")
+
+    def __init__(self, trace_id: str | None = None):
+        self.trace_id = trace_id or _new_trace_id()
+        self.marks: list[tuple] = []
+        self.launches = 0
+
+    def mark(self, name: str, t: float, **attrs) -> None:
+        self.marks.append((name, t, attrs or None))
+
+    def note_launch(self, n: int = 1) -> None:
+        self.launches += n
+
+    def t(self, name: str) -> float | None:
+        """Timestamp of the first mark named ``name`` (None if absent)."""
+        for mark_name, t, _ in list(self.marks):
+            if mark_name == name:
+                return t
+        return None
+
+    def attrs(self, name: str) -> dict:
+        for mark_name, _, attrs in list(self.marks):
+            if mark_name == name:
+                return attrs or {}
+        return {}
+
+    def breakdown(self) -> dict:
+        """Queue-wait / TTFT / service / total durations derived from the
+        marks — where this request's latency actually went."""
+        t_submit = self.t("submit")
+        t_admit = self.t("admit")
+        t_first = self.t("first_token")
+        t_done = self.t("complete") or self.t("failed") or self.t("expire")
+        out: dict = {"trace_id": self.trace_id, "launches": self.launches}
+        admit_attrs = self.attrs("admit")
+        if "kind" in admit_attrs:
+            out["prefill"] = admit_attrs["kind"]
+        if "prefill_tokens" in admit_attrs:
+            out["prefill_tokens"] = admit_attrs["prefill_tokens"]
+        if t_submit is not None:
+            if t_admit is not None:
+                out["queue_wait_s"] = round(t_admit - t_submit, 6)
+            if t_first is not None:
+                out["ttft_s"] = round(t_first - t_submit, 6)
+            if t_done is not None:
+                out["total_s"] = round(t_done - t_submit, 6)
+        if t_admit is not None and t_done is not None:
+            out["service_s"] = round(t_done - t_admit, 6)
+        return out
+
+    def timeline(self) -> list[dict]:
+        """The marks as dicts, with times relative to submit (JSON-ready
+        — what a flight dump's quarantined-request section carries)."""
+        marks = list(self.marks)
+        t0 = marks[0][1] if marks else 0.0
+        out = []
+        for name, t, attrs in marks:
+            d = {"event": name, "t_s": round(t - t0, 6)}
+            if attrs:
+                d.update(attrs)
+            out.append(d)
+        return out
+
+    def to_dict(self) -> dict:
+        return {**self.breakdown(), "timeline": self.timeline()}
 
 
 class Backpressure(RuntimeError):
@@ -73,6 +161,10 @@ class ServeRequest:
     # cache row (queue-wait measurement point).
     admit_time: float | None = None
     slot: int | None = None
+    # The distributed-tracing identity + timeline: assigned at submit,
+    # marked at every stage transition, surfaced as /statusz exemplars
+    # and in quarantine flight dumps.
+    trace: RequestTrace = dataclasses.field(default_factory=RequestTrace)
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -144,6 +236,7 @@ class RequestQueue:
                 submit_time=now,
                 deadline=None if deadline_s is None else now + deadline_s,
             )
+            req.trace.mark("submit", now, depth=len(self._pending))
             self._pending.append(req)
             self.cond.notify_all()
             return req
@@ -179,6 +272,7 @@ class RequestQueue:
             self._pending = [r for r in self._pending if not r.expired(now)]
             self.expired += len(dead)
             for r in dead:
+                r.trace.mark("expire", now)
                 r.future.set_exception(
                     DeadlineExceeded(
                         f"request {r.id} expired after "

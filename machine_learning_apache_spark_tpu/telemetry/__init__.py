@@ -30,6 +30,7 @@ from machine_learning_apache_spark_tpu.telemetry.aggregate import (
     write_rank_file,
 )
 from machine_learning_apache_spark_tpu.telemetry import events as _events_mod
+from machine_learning_apache_spark_tpu.telemetry import http as _http_mod
 from machine_learning_apache_spark_tpu.telemetry import (
     registry as _registry_mod,
 )
@@ -39,10 +40,23 @@ from machine_learning_apache_spark_tpu.telemetry.events import (
     Event,
     EventLog,
     annotate,
+    beacon,
+    beacon_update,
     enabled,
     get_log,
     set_enabled,
     telemetry_dir,
+)
+from machine_learning_apache_spark_tpu.telemetry.http import (
+    ENV_TELEMETRY_HTTP,
+    TelemetryHTTPServer,
+    get_http_server,
+    register_health_provider,
+    register_live_gauge,
+    register_status_provider,
+    start_http_server,
+    stop_http_server,
+    unregister_provider,
 )
 from machine_learning_apache_spark_tpu.telemetry.recorder import (
     FLIGHT_CAPACITY,
@@ -65,33 +79,46 @@ from machine_learning_apache_spark_tpu.telemetry.spans import (
 
 def reset() -> None:
     """Drop ALL process-global telemetry state (event log, registry,
-    cached enabled flag) — test hook and fork/spawn re-arm."""
+    cached enabled flag, beacon, HTTP server + providers) — test hook
+    and fork/spawn re-arm."""
+    _http_mod.reset()
     _events_mod.reset()
     _registry_mod.reset()
 
 __all__ = [
     "ENV_TELEMETRY",
     "ENV_TELEMETRY_DIR",
+    "ENV_TELEMETRY_HTTP",
     "Event",
     "EventLog",
     "FLIGHT_CAPACITY",
     "MetricsRegistry",
+    "TelemetryHTTPServer",
     "Timer",
     "annotate",
+    "beacon",
+    "beacon_update",
     "current_span_id",
     "dump_flight",
     "enabled",
     "flight_path",
+    "get_http_server",
     "get_log",
     "get_registry",
     "load_flight",
     "merge_gang_dir",
+    "register_health_provider",
+    "register_live_gauge",
+    "register_status_provider",
     "render_markdown",
     "reset",
     "set_enabled",
     "span",
+    "start_http_server",
+    "stop_http_server",
     "telemetry_dir",
     "timed_span",
     "traced",
+    "unregister_provider",
     "write_rank_file",
 ]

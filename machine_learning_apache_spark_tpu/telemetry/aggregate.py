@@ -354,6 +354,59 @@ def serving_report(events: list[dict], table: dict | None = None) -> dict:
     }
 
 
+#: How many slowest requests the gang-level request report lists.
+REQUEST_REPORT_SLOWEST = 8
+
+
+def request_report(events: list[dict]) -> dict:
+    """Per-request latency breakdown across the gang, from the
+    ``serving.request`` annotations ``ServingMetrics.on_trace`` emits
+    (one per retired request, attrs = the trace's breakdown dict):
+
+    - ``breakdown``: stats over each latency component — queue_wait
+      (submit → admit), ttft (submit → first token), service (admit →
+      retire), total (submit → retire);
+    - ``by_prefill``: request counts split by prefill kind ("hit" for
+      prefix-cache attach, "miss"/"padded" for computed prefill);
+    - ``slowest``: the ``REQUEST_REPORT_SLOWEST`` worst requests by total
+      latency, with rank and trace id — the exemplars to chase.
+
+    Empty dicts when no requests retired — the renderer omits the section.
+    """
+    fields = ("queue_wait_s", "ttft_s", "service_s", "total_s")
+    samples: dict[str, list[float]] = {f: [] for f in fields}
+    by_prefill: dict[str, int] = {}
+    rows: list[dict] = []
+    for ev in events:
+        if ev.get("kind") != "annotation" or ev.get("name") != "serving.request":
+            continue
+        attrs = ev.get("attrs") or {}
+        for f in fields:
+            v = attrs.get(f)
+            if v is not None:
+                samples[f].append(float(v))
+        kind = attrs.get("prefill")
+        if kind is not None:
+            by_prefill[str(kind)] = by_prefill.get(str(kind), 0) + 1
+        rows.append({
+            "rank": ev.get("rank"),
+            "trace_id": attrs.get("trace_id"),
+            "total_s": attrs.get("total_s"),
+            "queue_wait_s": attrs.get("queue_wait_s"),
+            "ttft_s": attrs.get("ttft_s"),
+            "launches": attrs.get("launches"),
+            "prefill": kind,
+        })
+    rows.sort(key=lambda r: r.get("total_s") or 0.0, reverse=True)
+    return {
+        "breakdown": {
+            f: _stats(vals) for f, vals in samples.items() if vals
+        },
+        "by_prefill": dict(sorted(by_prefill.items())),
+        "slowest": rows[:REQUEST_REPORT_SLOWEST],
+    }
+
+
 def merge_gang_dir(directory: str) -> dict:
     """One-call report over a gang workdir: find rank files, merge, build
     the phase table, skew report, and the comms/ingest/serving rollups."""
@@ -370,6 +423,7 @@ def merge_gang_dir(directory: str) -> dict:
         "comms": comms_report(events, table),
         "ingest": ingest_report(events, table),
         "serving": serving_report(events, table),
+        "requests": request_report(events),
     }
 
 
@@ -524,11 +578,80 @@ def render_markdown(report: dict) -> str:
                     lines.append(
                         f"| {name} | {rank} | {int(entry['total'])} |"
                     )
+    requests = report.get("requests") or {}
+    if requests.get("breakdown"):
+        lines += ["", "## Request latency breakdown (ms)", ""]
+        if requests.get("by_prefill"):
+            parts = ", ".join(
+                f"{k}: {v}" for k, v in requests["by_prefill"].items()
+            )
+            lines.append(f"- prefill kinds: {parts}")
+            lines.append("")
+        lines.append("| component | count | mean | p50 | p99 | max |")
+        lines.append("|---|---|---|---|---|---|")
+        for field, s in requests["breakdown"].items():
+            lines.append(
+                f"| {field} | {s['count']} | {_fmt(s['mean'])} "
+                f"| {_fmt(s['p50'])} | {_fmt(s['p99'])} | {_fmt(s['max'])} |"
+            )
+        if requests.get("slowest"):
+            lines.append("")
+            lines.append(
+                "| slowest | rank | total | queue wait | ttft | launches "
+                "| prefill |"
+            )
+            lines.append("|---|---|---|---|---|---|---|")
+            for r in requests["slowest"]:
+                lines.append(
+                    f"| {r.get('trace_id') or '-'} | {r.get('rank')} "
+                    f"| {_fmt(r.get('total_s'))} "
+                    f"| {_fmt(r.get('queue_wait_s'))} "
+                    f"| {_fmt(r.get('ttft_s'))} "
+                    f"| {r.get('launches') if r.get('launches') is not None else '-'} "
+                    f"| {r.get('prefill') or '-'} |"
+                )
+    return "\n".join(lines) + "\n"
+
+
+def render_status_markdown(rows: list[dict]) -> str:
+    """Live gang-status table for ``tools/gang_status.py``: one row per
+    rank, from scraped /healthz + /statusz payloads (plus heartbeat
+    sidecar enrichment). Each row dict may carry: rank, status, phase,
+    step, heartbeat_age_s, queue_depth, tokens_per_sec, in_flight,
+    occupancy, port."""
+    lines = ["# Gang status", ""]
+    lines.append(
+        "| rank | status | phase | step | beat age (s) | queue "
+        "| in flight | tok/s | kv occ | port |"
+    )
+    lines.append("|---|---|---|---|---|---|---|---|---|---|")
+
+    def cell(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return f"{v:.3f}" if v < 100 else f"{v:.1f}"
+        return str(v)
+
+    for r in sorted(rows, key=lambda r: (r.get("rank") is None, r.get("rank"))):
+        lines.append(
+            f"| {cell(r.get('rank'))} | {cell(r.get('status'))} "
+            f"| {cell(r.get('phase'))} | {cell(r.get('step'))} "
+            f"| {cell(r.get('heartbeat_age_s'))} "
+            f"| {cell(r.get('queue_depth'))} | {cell(r.get('in_flight'))} "
+            f"| {cell(r.get('tokens_per_sec'))} | {cell(r.get('occupancy'))} "
+            f"| {cell(r.get('port'))} |"
+        )
+    steps = [r.get("step") for r in rows if isinstance(r.get("step"), (int, float))]
+    if len(steps) > 1:
+        lines.append("")
+        lines.append(f"- step skew (max - min): {max(steps) - min(steps):g}")
     return "\n".join(lines) + "\n"
 
 
 __all__ = [
     "INPUT_BOUND_THRESHOLD",
+    "REQUEST_REPORT_SLOWEST",
     "comms_report",
     "find_rank_files",
     "ingest_report",
@@ -538,6 +661,8 @@ __all__ = [
     "phase_table",
     "rank_file_name",
     "render_markdown",
+    "render_status_markdown",
+    "request_report",
     "serving_report",
     "skew_report",
     "write_rank_file",

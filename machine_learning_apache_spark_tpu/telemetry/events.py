@@ -244,12 +244,14 @@ def get_log():
 
 
 def reset() -> None:
-    """Drop all global telemetry state (log, enabled cache) — test hook,
-    also re-arms the env reads for a forked/spawned child."""
+    """Drop all global telemetry state (log, enabled cache, beacon) —
+    test hook, also re-arms the env reads for a forked/spawned child."""
     global _ENABLED, _LOG
     with _STATE_LOCK:
         _ENABLED = None
         _LOG = None
+    with _BEACON_LOCK:
+        _BEACON.clear()
 
 
 def telemetry_dir() -> str | None:
@@ -266,6 +268,32 @@ def annotate(name: str, **attrs) -> None:
     get_log().emit("annotation", name, attrs=attrs or None)
 
 
+# -- the process beacon --------------------------------------------------------
+# A tiny "what am I doing right now" dict (phase, step, http_port, ...)
+# that long-running loops update and liveness surfaces read: the runner's
+# heartbeat thread folds it into each beat's JSON payload (via a
+# sys.modules peek — no import), and /healthz reports its age. Always on,
+# independent of the enabled() flag: it is liveness state, not telemetry
+# (one dict update under a lock, no thread, no ring growth).
+_BEACON_LOCK = threading.Lock()
+_BEACON: dict = {}
+
+
+def beacon_update(**fields) -> None:
+    """Merge ``fields`` into the beacon and stamp the update time
+    (``ts`` monotonic, ``wall`` wall-clock)."""
+    with _BEACON_LOCK:
+        _BEACON.update(fields)
+        _BEACON["ts"] = time.monotonic()
+        _BEACON["wall"] = time.time()
+
+
+def beacon() -> dict:
+    """A copy of the current beacon ({} before any update)."""
+    with _BEACON_LOCK:
+        return dict(_BEACON)
+
+
 __all__ = [
     "ENV_MAX_EVENTS",
     "ENV_TELEMETRY",
@@ -275,6 +303,8 @@ __all__ = [
     "KINDS",
     "NOOP_LOG",
     "annotate",
+    "beacon",
+    "beacon_update",
     "enabled",
     "get_log",
     "reset",

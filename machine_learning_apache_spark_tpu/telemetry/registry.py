@@ -30,21 +30,35 @@ from machine_learning_apache_spark_tpu.telemetry import events as _events
 _DEFAULT_HIST_SAMPLES = 4096
 
 
+def _fallback_percentile(samples, p):
+    if not samples:
+        return None
+    xs = sorted(samples)
+    k = max(0, min(len(xs) - 1, int(round(p / 100.0 * len(xs) + 0.5)) - 1))
+    return xs[k]
+
+
+_PERCENTILE_FN = None
+
+
 def _percentile(samples, p):
     """Nearest-rank percentile — the serving ledger's definition, reused.
     Falls back to a local copy if serving isn't importable (it is in every
-    supported environment; the fallback keeps stdlib-only contexts safe)."""
-    try:
-        from machine_learning_apache_spark_tpu.serving.metrics import (
-            percentile,
-        )
-    except Exception:
-        if not samples:
-            return None
-        xs = sorted(samples)
-        k = max(0, min(len(xs) - 1, int(round(p / 100.0 * len(xs) + 0.5)) - 1))
-        return xs[k]
-    return percentile(samples, p)
+    supported environment; the fallback keeps stdlib-only contexts safe).
+    The import resolves once, at first use, and the function is cached —
+    histogram summaries call this per quantile, and an import-machinery
+    round-trip per call is measurable under scrape load."""
+    global _PERCENTILE_FN
+    if _PERCENTILE_FN is None:
+        try:
+            from machine_learning_apache_spark_tpu.serving.metrics import (
+                percentile,
+            )
+
+            _PERCENTILE_FN = percentile
+        except Exception:
+            _PERCENTILE_FN = _fallback_percentile
+    return _PERCENTILE_FN(samples, p)
 
 
 class Counter:

@@ -488,6 +488,11 @@ def _run_epochs(
         # leaked ids, so parent attribution stays correct.
         epoch_span = telemetry.span("train.epoch", epoch=epoch)
         epoch_span.__enter__()
+        # Refresh the liveness beacon once per epoch: heartbeat payloads
+        # and /healthz report phase + step without touching the hot loop.
+        telemetry.beacon_update(
+            phase="train", epoch=epoch, step=global_step
+        )
         if hasattr(train_loader, "set_epoch"):
             train_loader.set_epoch(epoch)
         epoch_metrics = MetricBundle()
@@ -520,6 +525,9 @@ def _run_epochs(
             nonlocal last_emit_step
             covered = global_step - last_emit_step
             last_emit_step = global_step
+            # Log cadence doubles as beacon cadence: step stays fresh on
+            # /healthz and in heartbeat payloads at zero hot-loop cost.
+            telemetry.beacon_update(phase="train", step=global_step)
             _drain()
             emit(
                 f"epoch {epoch} step {global_step} | "

@@ -228,3 +228,13 @@ def echo_ingest_env():
         "tail": cfg.tail,
         "rank": int(os.environ.get("MLSPARK_PROCESS_ID", "-1")),
     }
+
+
+def echo_telemetry_http():
+    """The observability-plane env contract as a worker sees it
+    (Distributor(telemetry_http=...) must plumb MLSPARK_TELEMETRY_HTTP
+    into every rank's environment)."""
+    return {
+        "telemetry_http": os.environ.get("MLSPARK_TELEMETRY_HTTP"),
+        "rank": int(os.environ.get("MLSPARK_PROCESS_ID", "-1")),
+    }

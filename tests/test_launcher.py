@@ -292,3 +292,106 @@ class TestCommandsForHosts:
             assert p.returncode == 0, f"rank {rank} failed:\n{err[-2000:]}"
             assert f"MULTIHOST_RESULT rank={rank} world=2 sum=3.0" in out, out
 
+
+
+class TestObservabilityContracts:
+    """The launcher half of the live plane: JSON heartbeat payloads, the
+    ``telemetry_http`` knob, and the gang_status scraper end to end."""
+
+    def test_heartbeat_payload_json_round_trip(self, tmp_path):
+        import json
+        import time
+
+        from machine_learning_apache_spark_tpu.launcher.monitor import (
+            read_heartbeat,
+        )
+        from machine_learning_apache_spark_tpu.launcher.runner import (
+            _start_heartbeat,
+        )
+        from machine_learning_apache_spark_tpu.telemetry import events
+
+        events.beacon_update(phase="train", step=7, http_port=9100)
+        try:
+            hb = tmp_path / "heartbeat_3"
+            _start_heartbeat(str(hb), interval=0.05, rank=3)
+            deadline = time.monotonic() + 10
+            payload = {}
+            while time.monotonic() < deadline:
+                payload = read_heartbeat(str(hb))
+                if payload.get("phase") == "train":
+                    break
+                time.sleep(0.02)
+            assert payload["rank"] == 3
+            assert payload["pid"] > 0 and "wall" in payload
+            assert payload["phase"] == "train" and payload["step"] == 7
+            assert payload["http_port"] == 9100
+            # the beat is a valid single JSON document (atomic replace,
+            # never a torn append)
+            assert json.loads(hb.read_text()) == payload
+        finally:
+            events.reset()
+
+    def test_read_heartbeat_tolerates_legacy_and_torn_files(self, tmp_path):
+        from machine_learning_apache_spark_tpu.launcher.monitor import (
+            read_heartbeat,
+        )
+
+        legacy = tmp_path / "heartbeat_0"
+        legacy.touch()  # pre-JSON empty-touch beat
+        assert read_heartbeat(str(legacy)) == {}
+        torn = tmp_path / "heartbeat_1"
+        torn.write_text('{"rank": 1, "phase"')
+        assert read_heartbeat(str(torn)) == {}
+        assert read_heartbeat(str(tmp_path / "absent")) == {}
+        notdict = tmp_path / "heartbeat_2"
+        notdict.write_text("[1, 2]")
+        assert read_heartbeat(str(notdict)) == {}
+
+    def test_telemetry_http_knob_validation(self):
+        with pytest.raises(ValueError, match="telemetry_http"):
+            Distributor(num_processes=2, telemetry_http=-1)
+        with pytest.raises(ValueError, match="telemetry_http"):
+            Distributor(num_processes=2, telemetry_http=70000)
+
+    def test_telemetry_http_env_plumbing(self):
+        out = Distributor(
+            num_processes=2, platform="cpu", timeout=120, telemetry_http=0
+        ).run("launcher_workers:echo_telemetry_http")
+        assert out == {"telemetry_http": "0", "rank": 0}
+
+    def test_explicit_env_wins_over_knob(self):
+        # one spawned rank: a fixed port must not collide across ranks
+        from machine_learning_apache_spark_tpu.launcher.distributor import (
+            _free_port,
+        )
+
+        port = _free_port()
+        out = Distributor(
+            num_processes=1, platform="cpu", timeout=120, telemetry_http=0,
+            env={"MLSPARK_TELEMETRY_HTTP": str(port)},
+        ).run("launcher_workers:echo_telemetry_http")
+        assert out["telemetry_http"] == str(port)
+
+    def test_gang_status_smoke_subprocess(self):
+        """tools/gang_status.py --smoke is the tier-1 CI entry for the
+        scrape plane: a 2-rank gang with ephemeral HTTP ports, both ranks
+        discovered via sidecars and scraped live."""
+        import os
+        import subprocess
+        import sys
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        r = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo_root, "tools", "gang_status.py"),
+                "--smoke",
+            ],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        assert "smoke ok: scraped 2/2 ranks" in r.stdout
+        assert "# Gang status" in r.stdout

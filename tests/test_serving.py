@@ -789,10 +789,249 @@ def test_serve_bench_smoke_subprocess(tmp_path):
         "parity": True,
         "zero_recompiles": True,
         "conservation": True,
+        "midload_scrape": True,
     }
     assert art["parity"]["identical"] is True
+    scrape = art["modes"]["paged"]["midload_scrape"]
+    assert scrape["ok"] is True
+    assert 0 <= scrape["in_flight"] <= scrape["in_flight_cap"]
+    assert scrape["metrics_bytes"] > 0
     rows = art["modes"]["paged"]["rows"]
     assert rows and all(row["completed"] > 0 for row in rows)
     summary = art["modes"]["paged"]["engine_summary"]
     assert summary["padding_waste"] is not None
     assert art["modes"]["paged"]["paged_runtime"]["prefix_cache"]["hits"] > 0
+
+
+def _http_get(url, timeout=10.0):
+    """(body, status) for one scrape; HTTP errors still return their body
+    (a 503 /healthz carries the degraded payload)."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode("utf-8"), r.status
+    except urllib.error.HTTPError as e:
+        return e.read().decode("utf-8"), e.code
+
+
+def _http_get_json(url, timeout=10.0):
+    import json
+
+    body, code = _http_get(url, timeout)
+    return json.loads(body), code
+
+
+class TestObservabilityPlane:
+    """The live plane over a serving engine (docs/OBSERVABILITY.md "Live
+    plane"): per-request trace timelines, the /healthz verdict flipping
+    with quarantine and supervisor restarts, and concurrent /metrics
+    scrapes while decode runs."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_plane(self, monkeypatch):
+        from machine_learning_apache_spark_tpu import telemetry
+
+        monkeypatch.delenv("MLSPARK_TELEMETRY", raising=False)
+        monkeypatch.delenv("MLSPARK_TELEMETRY_DIR", raising=False)
+        monkeypatch.setenv("MLSPARK_TELEMETRY_HTTP", "0")  # ephemeral port
+        telemetry.reset()
+        yield
+        telemetry.reset()
+
+    @pytest.mark.parametrize("kv_mode", ["padded", "paged"])
+    def test_request_trace_timeline_end_to_end(self, tiny_translator, kv_mode):
+        """Every request carries a trace from submit to completion: the
+        mark vocabulary is present in order, the derived breakdown is
+        sane, batch spans record their members' trace ids, and the
+        engine keeps the slowest traces as exemplars."""
+        from machine_learning_apache_spark_tpu import telemetry
+
+        t, texts = tiny_translator
+        with t.serve(
+            boundaries=(8, 16), max_batch=4, max_wait_s=0.01,
+            max_new_tokens=8, kv_mode=kv_mode,
+        ) as eng:
+            futs = [eng.submit(s) for s in texts[:8]]
+            [f.result(timeout=120) for f in futs]
+            ids = {f.trace.trace_id for f in futs}
+            assert len(ids) == 8  # ids are unique
+            for f in futs:
+                names = [m[0] for m in f.trace.marks]
+                assert names[0] == "submit"
+                for required in ("batched", "admit", "first_token",
+                                 "complete"):
+                    assert required in names, (kv_mode, names)
+                bd = f.trace.breakdown()
+                assert bd["queue_wait_s"] >= 0.0
+                assert bd["ttft_s"] > 0.0
+                assert bd["service_s"] > 0.0
+                assert bd["total_s"] >= bd["ttft_s"]
+                assert f.trace.launches >= 1
+            # decode spans name their members — the batch↔request join
+            spans_with_members = [
+                e for e in telemetry.get_log().snapshot()
+                if e.name == "serving.batch" and (e.attrs or {}).get("requests")
+            ]
+            assert spans_with_members
+            seen = set()
+            for e in spans_with_members:
+                seen.update(e.attrs["requests"])
+            assert ids <= seen
+            # slowest-request exemplars, sorted worst-first
+            ex = eng.metrics.request_exemplars()
+            assert 1 <= len(ex) <= 8
+            assert {e["trace_id"] for e in ex} <= ids
+            totals = [e["total_s"] for e in ex]
+            assert totals == sorted(totals, reverse=True)
+            assert all(e["timeline"] for e in ex)
+            led = eng.metrics.ledger()
+            assert led["completed"] == 8 and led["in_flight"] == 0
+
+    def test_healthz_flips_on_quarantine_then_recovers(
+        self, tiny_translator, tmp_path, monkeypatch
+    ):
+        """A quarantined batch turns /healthz 503/degraded; the next
+        successful batch flips it back to 200/ok. The quarantine flight
+        dump carries every victim's trace timeline."""
+        from machine_learning_apache_spark_tpu import telemetry
+        from machine_learning_apache_spark_tpu.serving import InternalError
+        from machine_learning_apache_spark_tpu.telemetry import recorder
+        from machine_learning_apache_spark_tpu.utils import faults
+        from machine_learning_apache_spark_tpu.utils.faults import FaultPlan
+
+        monkeypatch.setenv("MLSPARK_TELEMETRY_DIR", str(tmp_path))
+        telemetry.reset()
+        t, texts = tiny_translator
+        faults.clear()
+        faults.install(FaultPlan.from_spec("raise@decode_batch:batch=0"))
+        try:
+            with t.serve(
+                boundaries=(8, 16), max_batch=4, max_wait_s=0.01,
+                max_new_tokens=8,
+            ) as eng:
+                srv = telemetry.get_http_server()
+                assert srv is not None
+                # one request -> one poisoned batch -> quarantine
+                victim = eng.submit(texts[0])
+                with pytest.raises(InternalError):
+                    victim.result(timeout=120)
+                deadline = time.monotonic() + 10
+                payload = code = None
+                while time.monotonic() < deadline:
+                    payload, code = _http_get_json(srv.url("/healthz"))
+                    if code == 503:
+                        break
+                    time.sleep(0.01)
+                assert code == 503 and payload["status"] == "degraded"
+                check = payload["checks"]["serving"]
+                assert check["healthy"] is False
+                assert check["quarantined"] >= 1
+                # flight dump landed with the victim's full timeline
+                dump = recorder.load_flight(
+                    recorder.flight_path(str(tmp_path))
+                )
+                traces = dump["extra"]["request_traces"]
+                assert traces and traces[0]["trace_id"] == \
+                    victim.trace.trace_id
+                marks = [m["event"] for m in traces[0]["timeline"]]
+                assert "failed" in marks
+                # next successful batch flips the verdict back
+                ok = eng.submit(texts[1]).result(timeout=120)
+                assert isinstance(ok, str)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    payload, code = _http_get_json(srv.url("/healthz"))
+                    if code == 200:
+                        break
+                    time.sleep(0.01)
+                assert code == 200 and payload["status"] == "ok"
+                assert payload["checks"]["serving"]["healthy"] is True
+        finally:
+            faults.clear()
+
+    def test_healthz_survives_supervisor_restart(self, tiny_translator):
+        """The outer containment ring is visible on the plane: a decode
+        loop death is restarted by the supervisor and /healthz reports
+        ok with the restart counted."""
+        from machine_learning_apache_spark_tpu import telemetry
+
+        t, texts = tiny_translator
+        eng = t.serve(
+            boundaries=(8, 16), max_batch=4, max_new_tokens=8, start=False
+        )
+        real = eng._decode_loop
+        died = {"n": 0}
+
+        def dying_then_real():
+            if died["n"] == 0:
+                died["n"] += 1
+                raise RuntimeError("decode loop death (injected)")
+            real()
+
+        eng._decode_loop = dying_then_real
+        eng.start()
+        try:
+            srv = telemetry.get_http_server()
+            assert srv is not None
+            out = eng.submit(texts[0]).result(timeout=120)
+            assert isinstance(out, str)
+            payload, code = _http_get_json(srv.url("/healthz"))
+            assert code == 200 and payload["status"] == "ok"
+            assert payload["checks"]["serving"]["loop_restarts"] == 1
+            assert payload["checks"]["serving"]["worker_alive"] is True
+        finally:
+            eng.stop()
+
+    def test_concurrent_scrapes_under_decode_load(self, tiny_translator):
+        """4 scraper threads hammer /metrics and /statusz while 24
+        requests decode: every scrape answers 200, every mid-flight
+        ledger balances, and serving results are unaffected."""
+        from machine_learning_apache_spark_tpu import telemetry
+
+        t, texts = tiny_translator
+        with t.serve(
+            boundaries=(8, 16), max_batch=4, max_wait_s=0.01,
+            max_new_tokens=8,
+        ) as eng:
+            srv = telemetry.get_http_server()
+            assert srv is not None
+            stop = threading.Event()
+            failures, ledgers = [], []
+
+            def scraper():
+                try:
+                    while not stop.is_set():
+                        body, code = _http_get(srv.url("/metrics"))
+                        assert code == 200 and "mlspark_serving_" in body
+                        payload, code = _http_get_json(srv.url("/statusz"))
+                        assert code == 200
+                        led = payload["sections"]["serving"]["ledger"]
+                        assert led["in_flight"] >= 0
+                        assert led["submitted"] == (
+                            led["completed"] + led["rejected"]
+                            + led["expired"] + led["failed"]
+                            + led["in_flight"]
+                        )
+                        ledgers.append(led)
+                except Exception as e:  # noqa: BLE001 — reported below
+                    failures.append(e)
+
+            threads = [
+                threading.Thread(target=scraper, daemon=True)
+                for _ in range(4)
+            ]
+            for th in threads:
+                th.start()
+            try:
+                futs = [eng.submit(s) for s in texts[:24]]
+                outs = [f.result(timeout=120) for f in futs]
+            finally:
+                stop.set()
+                for th in threads:
+                    th.join(timeout=30)
+            assert not failures, failures
+            assert len(outs) == 24 and ledgers
+            assert max(led["submitted"] for led in ledgers) <= 24
+            eng.metrics.check_conservation(in_flight=0)

@@ -20,6 +20,7 @@ from machine_learning_apache_spark_tpu import telemetry
 from machine_learning_apache_spark_tpu.telemetry import (
     aggregate,
     events,
+    http,
     recorder,
     registry,
     spans,
@@ -38,6 +39,7 @@ def fresh_telemetry(monkeypatch):
     monkeypatch.delenv(events.ENV_TELEMETRY, raising=False)
     monkeypatch.delenv(events.ENV_TELEMETRY_DIR, raising=False)
     monkeypatch.delenv(events.ENV_MAX_EVENTS, raising=False)
+    monkeypatch.delenv(http.ENV_TELEMETRY_HTTP, raising=False)
     monkeypatch.delenv("MLSPARK_PROCESS_ID", raising=False)
     telemetry.reset()
     yield
@@ -480,3 +482,268 @@ class TestBackCompat:
             ("span_start", "square"), ("span_end", "square"),
         ]
         assert evs[0].attrs == {"step": 1}
+
+
+# -- the live HTTP plane -------------------------------------------------------
+
+
+class TestHTTPPlane:
+    """telemetry/http.py: endpoint payload functions (no socket), the
+    provider registry lifecycle, sidecar discovery, the env port
+    contract, and the real server over loopback."""
+
+    def test_metrics_text_includes_registry_and_live_gauges(self):
+        registry.get_registry().counter("plane", "hits").inc(3)
+        http.register_live_gauge("queue", "depth", lambda: 7.0)
+        text = http.metrics_text()
+        assert "mlspark_plane_hits 3" in text
+        assert "mlspark_queue_depth 7" in text
+        # a raising gauge is skipped, never a dead scrape
+        http.register_live_gauge("bad", "gauge", lambda: 1 / 0)
+        text = http.metrics_text()
+        assert "mlspark_queue_depth" in text
+        assert "mlspark_bad_gauge" not in text
+
+    def test_healthz_verdict_and_beacon_age(self):
+        payload, healthy = http.healthz()
+        assert healthy and payload["status"] == "ok"
+        assert payload["heartbeat_age_s"] is None  # no beacon yet
+        events.beacon_update(phase="train", step=12)
+        http.register_health_provider(
+            "worker", lambda: {"healthy": True, "note": "fine"}
+        )
+        payload, healthy = http.healthz()
+        assert healthy
+        assert payload["phase"] == "train" and payload["step"] == 12
+        assert payload["heartbeat_age_s"] is not None
+        assert payload["heartbeat_age_s"] < 60.0
+        assert payload["checks"]["worker"]["note"] == "fine"
+        # one unhealthy check flips the verdict; a raising one does too
+        http.register_health_provider("worker", lambda: {"healthy": False})
+        payload, healthy = http.healthz()
+        assert not healthy and payload["status"] == "degraded"
+        http.register_health_provider("worker", lambda: 1 / 0)
+        payload, healthy = http.healthz()
+        assert not healthy
+        assert "error" in payload["checks"]["worker"]
+
+    def test_statusz_sections_and_provider_isolation(self, monkeypatch):
+        monkeypatch.setenv("MLSPARK_DP_MODE", "zero1")
+        http.register_status_provider("good", lambda: {"answer": 42})
+        http.register_status_provider("bad", lambda: 1 / 0)
+        payload = http.statusz()
+        assert payload["artifact"] == "statusz"
+        assert payload["config"]["MLSPARK_DP_MODE"] == "zero1"
+        assert payload["sections"]["good"] == {"answer": 42}
+        assert "error" in payload["sections"]["bad"]  # isolated, not fatal
+        assert "python" in payload["build"]
+
+    def test_flightz_tails_the_ring(self):
+        for i in range(20):
+            telemetry.annotate("tick", i=i)
+        payload = http.flightz(5)
+        assert payload["event_count"] == 5
+        assert [e["attrs"]["i"] for e in payload["events"]] == list(
+            range(15, 20)
+        )
+
+    def test_unregister_drops_status_health_and_gauges(self):
+        http.register_status_provider("serving", lambda: {})
+        http.register_health_provider("serving", lambda: {"healthy": False})
+        http.register_live_gauge("serving", "queue_depth", lambda: 1.0)
+        http.unregister_provider("serving")
+        payload, healthy = http.healthz()
+        assert healthy and "serving" not in payload["checks"]
+        assert "serving" not in http.statusz()["sections"]
+        assert "mlspark_serving_queue_depth" not in http.metrics_text()
+
+    def test_port_sidecar_round_trip(self, tmp_path):
+        path = http.write_port_sidecar(1234, directory=str(tmp_path), rank=3)
+        assert path and path.endswith("http_rank3.json")
+        (tmp_path / "http_rank9.json").write_text("{torn")  # skipped
+        found = http.find_port_sidecars(str(tmp_path))
+        assert list(found) == [3]
+        assert found[3]["port"] == 1234 and found[3]["pid"] == os.getpid()
+        # no telemetry dir configured -> no sidecar, no crash
+        assert http.write_port_sidecar(1234) is None
+
+    def test_http_port_from_env(self, monkeypatch):
+        assert http.http_port_from_env() is None
+        for raw, expect in [
+            ("0", 0), ("8080", 8080), ("", None), ("  ", None),
+            ("nope", None), ("-1", None), ("70000", None),
+        ]:
+            monkeypatch.setenv(http.ENV_TELEMETRY_HTTP, raw)
+            assert http.http_port_from_env() == expect, raw
+
+    def test_server_disabled_means_zero_threads(self, monkeypatch):
+        import threading
+
+        # no MLSPARK_TELEMETRY_HTTP: no server, no thread
+        before = threading.active_count()
+        assert http.start_http_server() is None
+        assert threading.active_count() == before
+        assert http.get_http_server() is None
+        # telemetry killed outright: even an explicit port starts nothing
+        monkeypatch.setenv(events.ENV_TELEMETRY, "0")
+        telemetry.reset()
+        monkeypatch.setenv(http.ENV_TELEMETRY_HTTP, "0")
+        assert http.start_http_server() is None
+        assert threading.active_count() == before
+
+    def test_server_end_to_end_scrape(self, tmp_path, monkeypatch):
+        import urllib.error
+        import urllib.request
+
+        monkeypatch.setenv(events.ENV_TELEMETRY_DIR, str(tmp_path))
+        telemetry.reset()
+        registry.get_registry().counter("scrape", "count").inc(2)
+        http.register_health_provider("w", lambda: {"healthy": True})
+        srv = http.start_http_server(0, rank=1)
+        assert srv is not None and srv.port > 0
+        assert http.start_http_server(0) is srv  # idempotent
+        # sidecar published + beacon carries the port
+        assert http.find_port_sidecars(str(tmp_path))[1]["port"] == srv.port
+        assert events.beacon()["http_port"] == srv.port
+
+        def get(path):
+            with urllib.request.urlopen(srv.url(path), timeout=10) as r:
+                return r.read().decode(), r.status
+
+        body, code = get("/metrics")
+        assert code == 200 and "mlspark_scrape_count 2" in body
+        body, code = get("/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        body, code = get("/statusz")
+        assert code == 200 and json.loads(body)["artifact"] == "statusz"
+        body, code = get("/flightz?n=3")
+        assert code == 200 and json.loads(body)["event_count"] <= 3
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/nope")
+        assert ei.value.code == 404
+        # degraded health answers 503 with the payload attached
+        http.register_health_provider("w", lambda: {"healthy": False})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "degraded"
+        sidecar = srv.sidecar_path
+        http.stop_http_server()
+        assert http.get_http_server() is None
+        assert not os.path.exists(sidecar)  # sidecar retracted on stop
+
+
+class TestBeacon:
+    def test_update_and_reset(self):
+        assert events.beacon() == {}
+        events.beacon_update(phase="train", step=3)
+        b = events.beacon()
+        assert b["phase"] == "train" and b["step"] == 3
+        assert "ts" in b and "wall" in b
+        events.beacon_update(step=4)  # merge, not replace
+        assert events.beacon()["phase"] == "train"
+        assert events.beacon()["step"] == 4
+        telemetry.reset()
+        assert events.beacon() == {}
+
+    def test_beacon_works_when_telemetry_disabled(self, monkeypatch):
+        """The beacon is liveness, not telemetry: the heartbeat payload
+        must carry phase/step even with MLSPARK_TELEMETRY=0."""
+        monkeypatch.setenv(events.ENV_TELEMETRY, "0")
+        telemetry.reset()
+        events.beacon_update(phase="train", step=1)
+        assert events.beacon()["phase"] == "train"
+
+
+class TestRequestReport:
+    def _ev(self, rank, trace_id, total, queue=0.001, prefill="miss"):
+        return {
+            "kind": "annotation", "name": "serving.request", "rank": rank,
+            "attrs": {
+                "trace_id": trace_id, "total_s": total,
+                "queue_wait_s": queue, "ttft_s": total / 2,
+                "service_s": total - queue, "launches": 3,
+                "prefill": prefill,
+            },
+        }
+
+    def test_breakdown_slowest_and_prefill_split(self):
+        evs = [
+            self._ev(0, "r0-a", 0.5, prefill="miss"),
+            self._ev(1, "r1-b", 2.0, prefill="hit"),
+            self._ev(0, "r0-c", 1.0, prefill="hit"),
+        ]
+        evs.append({"kind": "annotation", "name": "other", "attrs": {}})
+        rep = aggregate.request_report(evs)
+        assert rep["breakdown"]["total_s"]["count"] == 3
+        assert rep["breakdown"]["total_s"]["max"] == 2.0
+        assert rep["by_prefill"] == {"hit": 2, "miss": 1}
+        assert [r["trace_id"] for r in rep["slowest"]] == [
+            "r1-b", "r0-c", "r0-a"
+        ]
+        assert rep["slowest"][0]["rank"] == 1
+
+    def test_empty_without_request_events(self):
+        rep = aggregate.request_report([])
+        assert rep["breakdown"] == {} and rep["slowest"] == []
+
+    def test_markdown_section_renders(self):
+        report = {
+            "ranks": [0], "event_count": 1, "phases": {}, "skew": {},
+            "requests": aggregate.request_report(
+                [self._ev(0, "r0-a", 0.25)]
+            ),
+        }
+        md = aggregate.render_markdown(report)
+        assert "## Request latency breakdown (ms)" in md
+        assert "r0-a" in md
+
+    def test_live_report_round_trip(self):
+        """on_trace -> event log -> request_report: the real producer
+        feeds the real consumer."""
+        from machine_learning_apache_spark_tpu.serving.metrics import (
+            ServingMetrics,
+        )
+        from machine_learning_apache_spark_tpu.serving.queue import (
+            RequestTrace,
+        )
+
+        class _Req:
+            def __init__(self, i):
+                self.trace = RequestTrace(f"t-{i}")
+                self.trace.mark("submit", 0.0)
+                self.trace.mark("admit", 0.01 * (i + 1))
+                self.trace.mark("first_token", 0.05)
+                self.trace.mark("complete", 0.1 * (i + 1))
+
+        m = ServingMetrics()
+        for i in range(3):
+            m.on_trace(_Req(i))
+        evs = [e.to_dict() for e in events.get_log().snapshot()]
+        rep = aggregate.request_report(evs)
+        assert rep["breakdown"]["total_s"]["count"] == 3
+        assert rep["slowest"][0]["trace_id"] == "t-2"
+        assert len(m.request_exemplars()) == 3
+
+
+class TestStatusMarkdown:
+    def test_render_rows_and_step_skew(self):
+        rows = [
+            {"rank": 1, "status": "ok", "phase": "train", "step": 12,
+             "heartbeat_age_s": 0.5, "queue_depth": 3, "in_flight": 2,
+             "tokens_per_sec": 123.4, "occupancy": 0.25, "port": 9100},
+            {"rank": 0, "status": "unreachable", "step": 10},
+        ]
+        md = aggregate.render_status_markdown(rows)
+        assert md.startswith("# Gang status")
+        lines = md.splitlines()
+        r0 = next(ln for ln in lines if ln.startswith("| 0 "))
+        r1 = next(ln for ln in lines if ln.startswith("| 1 "))
+        assert lines.index(r0) < lines.index(r1)  # sorted by rank
+        assert "unreachable" in r0
+        assert "123.4" in r1 and "9100" in r1
+        assert "step skew (max - min): 2" in md
+
+    def test_missing_fields_render_dashes(self):
+        md = aggregate.render_status_markdown([{"rank": 0}])
+        assert "| 0 | - | - | - |" in md

@@ -11,20 +11,27 @@ decode program, chunked prefill, prefix sharing), each sweep
 self-calibrated against its own unloaded capacity so the load fractions
 mean the same thing in both columns.
 
-Three semantic gates ride every run:
+Four semantic gates ride every run:
 
 - **parity** — the two modes must produce token-identical greedy outputs
   for the same prompts (the padded path is the equivalence oracle);
 - **zero recompiles** — no program compiles after warmup in either mode,
   across the whole sweep's occupancy/length mix;
 - **conservation** — every submitted request is accounted completed /
-  rejected / expired / failed after the drain.
+  rejected / expired / failed after the drain;
+- **midload_scrape** — the bench runs with the live observability plane
+  enabled (``MLSPARK_TELEMETRY_HTTP=0`` → per-process HTTP server on an
+  ephemeral port) and scrapes ``/statusz`` + ``/metrics`` at the middle
+  of the saturation (1.0×) level: the scrape must answer, and the
+  scraped ledger's derived ``in_flight`` must stay within the engine's
+  structural bound — the conservation law holding *under* concurrent
+  decode load, not just after the drain.
 
 ``--smoke`` is the tier-1 CI entry: tiny model, parity gate, and a short
 paged-only sweep, exiting nonzero if any gate fails. The full run writes
-``BENCH_SERVE_r02.json`` (``--out`` relocates) with both columns, the
-saturation-knee comparison, and each engine's metrics ledger (padding-
-waste counters included).
+``BENCH_SERVE_r03.json`` (``--out`` relocates) with both columns, the
+saturation-knee comparison, each engine's metrics ledger (padding-
+waste counters included), and the mid-load snapshot.
 
 Usage: JAX_PLATFORMS=cpu python tools/serve_bench.py [--smoke] [--out P]
 """
@@ -151,10 +158,51 @@ def parity_gate(translator, texts, n: int, knobs: dict) -> dict:
     }
 
 
+def _midload_scrape(in_flight_cap: int, delay: float) -> dict:
+    """Scrape the live plane mid-level (called from a side thread while
+    ``run_level`` drives saturation traffic): /statusz must answer, the
+    scraped ledger's in_flight must respect the engine's structural bound
+    (0 <= in_flight <= queue + rows + one forming batch), and /metrics
+    must produce a non-empty exposition. This is the observability plane's
+    load test: scraping a saturated engine, not an idle one."""
+    import urllib.request
+
+    from machine_learning_apache_spark_tpu import telemetry
+
+    time.sleep(delay)
+    server = telemetry.get_http_server()
+    if server is None:
+        return {"ok": False, "error": "no http server running"}
+    out: dict = {"port": server.port}
+    try:
+        with urllib.request.urlopen(server.url("/statusz"), timeout=10) as r:
+            status = json.loads(r.read().decode("utf-8"))
+        with urllib.request.urlopen(server.url("/metrics"), timeout=10) as r:
+            metrics_text = r.read().decode("utf-8")
+    except Exception as e:  # noqa: BLE001 — the gate reports, main fails
+        return {**out, "ok": False, "error": repr(e)}
+    serving = (status.get("sections") or {}).get("serving") or {}
+    ledger = serving.get("ledger") or {}
+    in_flight = ledger.get("in_flight")
+    conserved = in_flight is not None and 0 <= in_flight <= in_flight_cap
+    out.update({
+        "ok": bool(conserved and metrics_text.strip()),
+        "in_flight": in_flight,
+        "in_flight_cap": in_flight_cap,
+        "ledger": ledger,
+        "queue_depth": serving.get("queue_depth"),
+        "health": (status.get("health") or {}).get("status"),
+        "slowest_requests": serving.get("slowest_requests"),
+        "metrics_bytes": len(metrics_text),
+    })
+    return out
+
+
 def run_mode(translator, texts, mode: str, knobs: dict,
              duration: float, fractions) -> dict:
     """One mode's full sweep on its own engine: calibrate unloaded
-    capacity, sweep load fractions of it, assert conservation."""
+    capacity, sweep load fractions of it, assert conservation — and, at
+    the saturation level, scrape the live plane mid-traffic."""
     engine = translator.serve(**{**knobs, "kv_mode": mode})
     with engine:
         # Steady-state warm pass (both modes, same traffic): every
@@ -190,13 +238,33 @@ def run_mode(translator, texts, mode: str, knobs: dict,
         }), flush=True)
 
         rows = []
+        scrape: dict = {}
         for frac in fractions:
             rate = max(capacity * frac, 1.0)
+            scraper = None
+            if frac == 1.0:
+                # In-flight structural bound: everything queued, every
+                # cache row, plus one batch mid-formation between the two.
+                cap = (
+                    knobs["max_queue_depth"] + knobs["max_active"]
+                    + knobs["max_batch"]
+                )
+                scraper = threading.Thread(
+                    target=lambda: scrape.update(
+                        _midload_scrape(cap, delay=duration / 2)
+                    ),
+                    name="serve-bench-scraper", daemon=True,
+                )
+                scraper.start()
             row = {"load_fraction": frac, **run_level(
                 engine, texts, rate, duration
             )}
+            if scraper is not None:
+                scraper.join(timeout=duration + 30)
             rows.append(row)
             print(json.dumps({"mode": mode, **row}), flush=True)
+        print(json.dumps({"mode": mode, "midload_scrape": scrape}),
+              flush=True)
 
         # Every request the bench ever submitted must be accounted for —
         # raises ConservationError (failing the bench like a test) on a leak.
@@ -210,6 +278,7 @@ def run_mode(translator, texts, mode: str, knobs: dict,
             "recompiles_after_warmup": engine.recompiles_after_warmup,
             "engine_summary": engine.metrics.summary(),
             "conservation": ledger,
+            "midload_scrape": scrape,
         }
         if mode == "paged":
             result["paged_runtime"] = engine.runtime.stats()
@@ -218,19 +287,30 @@ def run_mode(translator, texts, mode: str, knobs: dict,
 
 def main() -> None:
     smoke = "--smoke" in sys.argv
-    out_path = "BENCH_SERVE_r02.json"
+    out_path = "BENCH_SERVE_r03.json"
     if "--out" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
     if smoke:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # The bench measures serving WITH the live plane on (the production
+    # configuration): ephemeral port, scraped mid-load by the
+    # midload_scrape gate. An explicit MLSPARK_TELEMETRY_HTTP (or
+    # MLSPARK_TELEMETRY=0, which keeps the plane dark and fails the
+    # gate loudly) wins.
+    os.environ.setdefault("MLSPARK_TELEMETRY_HTTP", "0")
 
     translator, texts = build_translator(tiny=smoke)
     knobs = dict(
         boundaries=(8, 16), max_batch=8, max_wait_s=0.005,
         max_queue_depth=128, max_new_tokens=10,
         # The paged engine can afford to cache every distinct prompt in
-        # this workload — prefix sharing is the feature under test.
-        prefix_cache_size=64 if smoke else 256,
+        # this workload — prefix sharing is the feature under test. The
+        # capacity must cover all 256 distinct prompts in BOTH profiles:
+        # the sweep cycles prompts round-robin, and a smaller LRU against
+        # a cyclic access pattern degenerates to ~zero hits (everything
+        # evicted just before reuse), which made the smoke's
+        # prefix-cache gate a coin flip on a loaded machine.
+        prefix_cache_size=256,
         # One launch covers a full generation: with zero-cost cache-hit
         # admission the budget no longer underfills rows, so the larger
         # launch trades TTFT granularity for ~2x fewer host round-trips.
@@ -258,6 +338,9 @@ def main() -> None:
             m["recompiles_after_warmup"] == 0 for m in modes.values()
         ),
         "conservation": True,  # run_mode raised already if violated
+        "midload_scrape": all(
+            m["midload_scrape"].get("ok") for m in modes.values()
+        ),
     }
     knee = None
     if "padded" in modes and "paged" in modes:

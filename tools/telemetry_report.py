@@ -5,9 +5,11 @@ The offline half of ``telemetry.aggregate``: point it at a directory of
 ``MLSPARK_TELEMETRY_DIR`` pointed) and get the gang-wide per-phase
 p50/p99 table, the rank-skew (straggler attribution) report, a comms
 section (zero1 wire bytes per step, collective span p50/p99) when the
-run recorded any ``comms.*`` events, and an ingest section (``data.*``
+run recorded any ``comms.*`` events, an ingest section (``data.*``
 stage durations, prefetch-buffer occupancy, input-bound vs compute-bound
-verdict) when it recorded any ``data.*`` events.
+verdict) when it recorded any ``data.*`` events, and serving + per-request
+latency-breakdown sections (queue wait / ttft / service / total stats,
+slowest-request exemplars) when it recorded any ``serving.*`` events.
 
 Usage::
 
@@ -59,6 +61,8 @@ def _report_from_files(paths: list[str]) -> dict:
         "skew": aggregate.skew_report(table),
         "comms": aggregate.comms_report(events, table),
         "ingest": aggregate.ingest_report(events, table),
+        "serving": aggregate.serving_report(events, table),
+        "requests": aggregate.request_report(events),
     }
 
 
