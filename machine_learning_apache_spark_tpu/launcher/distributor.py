@@ -150,6 +150,7 @@ class Distributor:
         platform: str | None = None,
         env: dict[str, str] | None = None,
         dp_mode: str | None = None,
+        dp_overlap: bool | None = None,
         serve_kv_mode: str | None = None,
         telemetry_http: int | None = None,
         ingest: dict | None = None,
@@ -177,6 +178,15 @@ class Distributor:
                 "'zero1')"
             )
         self.dp_mode = dp_mode
+        # The zero1 overlap schedule rides the same contract: the boolean
+        # knob becomes MLSPARK_ZERO1_OVERLAP in every worker
+        # (Zero1Config.from_env resolves it; workers default to overlap
+        # on when neither knob nor env is set).
+        if dp_overlap is not None and not isinstance(dp_overlap, bool):
+            raise ValueError(
+                f"dp_overlap must be a bool or None, got {dp_overlap!r}"
+            )
+        self.dp_overlap = dp_overlap
         # Serving KV-cache mode, same env contract shape: the knob becomes
         # MLSPARK_SERVE_KV_MODE in every worker, which ServingEngine
         # resolves when kv_mode isn't passed explicitly ("paged" is the
@@ -403,6 +413,8 @@ class Distributor:
             # dict(os.environ) above, and explicit env= still wins below.
             if self.dp_mode is not None:
                 env["MLSPARK_DP_MODE"] = self.dp_mode
+            if self.dp_overlap is not None:
+                env["MLSPARK_ZERO1_OVERLAP"] = "1" if self.dp_overlap else "0"
             # Serving KV mode rides the same contract (constructor >
             # inherited env; explicit env= still wins below).
             if self.serve_kv_mode is not None:

@@ -22,10 +22,20 @@ class MLP(nn.Module):
     ``layers[0]`` is the expected input width (validated), the rest are layer
     output widths. ``activation`` sits between layers only; logits come out
     raw for a downstream softmax cross-entropy.
+
+    ``tp_rules=True`` annotates the Dense kernels with logical axis names
+    (alternating ``("embed", "mlp")`` / ``("mlp", "embed")`` — the classic
+    column-then-row parallel pairing) so ``parallel.tensor_parallel`` can
+    place them over a mesh ``"model"`` axis. Off by default: the plain
+    reference model carries no partitioning metadata, and annotated inits
+    return boxed ``nn.Partitioned`` leaves that callers must unbox or
+    place. Hidden widths must divide the model-axis size to actually
+    shard (non-divisible dims fall back to replicated, loudly).
     """
 
     layers: Sequence[int] = (4, 5, 4, 3)
     activation: Callable[[jnp.ndarray], jnp.ndarray] = nn.sigmoid
+    tp_rules: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
@@ -37,7 +47,14 @@ class MLP(nn.Module):
                 f"MLP expects {self.layers[0]} input features, got {x.shape[-1]}"
             )
         for i, width in enumerate(self.layers[1:]):
-            x = nn.Dense(width, name=f"dense_{i}")(x)
+            if self.tp_rules:
+                names = ("embed", "mlp") if i % 2 == 0 else ("mlp", "embed")
+                kernel_init = nn.with_partitioning(
+                    nn.initializers.lecun_normal(), names
+                )
+                x = nn.Dense(width, name=f"dense_{i}", kernel_init=kernel_init)(x)
+            else:
+                x = nn.Dense(width, name=f"dense_{i}")(x)
             if i < len(self.layers) - 2:
                 x = self.activation(x)
         return x

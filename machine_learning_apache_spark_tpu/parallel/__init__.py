@@ -12,6 +12,7 @@ from machine_learning_apache_spark_tpu.parallel.mesh import (
     PIPELINE_AXIS,
     SEQ_AXIS,
     batch_sharding,
+    data_model_mesh,
     data_parallel_mesh,
     make_mesh,
     replicate,
@@ -66,6 +67,7 @@ __all__ = [
     "PIPELINE_AXIS",
     "SEQ_AXIS",
     "batch_sharding",
+    "data_model_mesh",
     "data_parallel_mesh",
     "make_mesh",
     "replicate",

@@ -76,6 +76,16 @@ def data_parallel_mesh(n: int | None = None) -> Mesh:
     return make_mesh({DATA_AXIS: 0 if n is None else n}, devices=devices)
 
 
+def data_model_mesh(model: int, data: int | None = None) -> Mesh:
+    """The hybrid 2-D mesh: ``data x model`` with ``model`` innermost
+    (canonical axis order), the layout ``fit(dp_mode="zero1")`` composes
+    ZeRO-1 and tensor parallelism over. ``data=None`` spreads whatever
+    devices remain after the model axis (``data = n_devices / model``)."""
+    if model <= 0:
+        raise ValueError(f"model axis size must be positive, got {model}")
+    return make_mesh({DATA_AXIS: 0 if data is None else data, MODEL_AXIS: model})
+
+
 def batch_sharding(mesh: Mesh, *, axis: str = DATA_AXIS) -> NamedSharding:
     """Sharding for a batch-leading array: dim 0 split over the data axis —
     the ``DistributedSampler`` partitioning (``distributed_cnn.py:112-119``)

@@ -164,6 +164,13 @@ def shard_state(
     ``tests/test_tensor_parallel.py``). Leaves
     whose leading dim the data axis does not divide, scalar counters, and
     dims already sharded by a logical rule are left as-is.
+
+    For the fused sharded-update step — bucketed reduce-scatter with
+    comm/compute overlap and a guaranteed ~1/N flat optimizer footprint —
+    prefer ``fit(dp_mode="zero1")`` (``parallel.zero``); it accepts both
+    pure-data and hybrid ``data x model`` meshes directly. ``zero1=True``
+    here remains the lightweight leading-dim variant for states this
+    placement already fits.
     """
     unboxed = nn.unbox(state)
     specs = nn.get_partition_spec(state)

@@ -25,13 +25,38 @@ predates it and stays supported as ``fit(zero1=True)``):
   moments accumulate in fp32 (master copies);
 - the optimizer state is built **sharded from the start**
   (``jit(out_shardings=...)`` over ``tx.init``): the replicated moments
-  never exist, so peak per-chip optimizer memory is ~1/N from step 0.
+  never exist, so peak per-chip optimizer memory is ~1/N from step 0;
+- with ``overlap=True`` (the default; ``MLSPARK_ZERO1_OVERLAP``) the
+  buckets form a **pipeline instead of a barrier**: each bucket's
+  gradient segment is assembled straight from the grad leaves it spans
+  (no full-vector concat first) and its ``psum_scatter`` is issued in
+  reverse bucket order — the order backward produces gradients — so the
+  reduce-scatter of bucket k overlaps the still-running backward of
+  earlier layers; on the tail, the optimizer update runs **per bucket**
+  and each bucket's params ``all_gather`` is issued immediately, so the
+  gather of bucket k hides behind the update of bucket k+1. The pipeline
+  is elementwise-identical to the serial schedule, so fp32 overlap mode
+  is bit-identical to overlap-off (the equivalence gate pins it).
 
-Shard layout: device ``i`` owns the ``i``-th 1/N slice of *every
-bucket* (what ``psum_scatter`` hands it), concatenated. The flat
-optimizer-state leaves live in that bucket-major order; it is internally
-consistent across init/update/checkpoint and no caller reads them
-elementwise.
+Hybrid data x model meshes: ``make_zero1_step`` composes with tensor
+parallelism on 2-D ``data x model`` meshes (veScale, arxiv 2509.07003:
+the sharded-update spec is orthogonal to TP). On a hybrid mesh the step
+switches from the explicit ``shard_map`` program to the *implicit* form
+of the same rewrite — params keep their TP placement
+(``tensor_parallel`` logical rules), the flat fp32 master/optimizer
+vector is sharded over ``(data, model)`` jointly (so moments shrink by
+the full device count), and ``with_sharding_constraint`` pins the
+layouts while XLA's weight-update sharding compiles the
+reduce-scatter/allgather pair and schedules its own overlap. Hybrid is
+fp32-wire only (the implicit path cannot express a compressed wire
+dtype) and trains to parity with a pure-TP + replicated-DP reference
+(tests/test_zero.py pins it).
+
+Shard layout (explicit path): device ``i`` owns the ``i``-th 1/N slice
+of *every bucket* (what ``psum_scatter`` hands it), concatenated. The
+flat optimizer-state leaves live in that bucket-major order; it is
+internally consistent across init/update/checkpoint and no caller reads
+them elementwise.
 
 Limitations (documented, checked where cheap): the optimizer chain must
 be elementwise per-parameter (sgd/adam/adamw + schedules are; a
@@ -54,7 +79,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from machine_learning_apache_spark_tpu.utils.jax_compat import shard_map
 
 # Environment contract (launcher gang plumbing: the driver sets these on
@@ -62,6 +87,7 @@ from machine_learning_apache_spark_tpu.utils.jax_compat import shard_map
 ENV_DP_MODE = "MLSPARK_DP_MODE"
 ENV_BUCKET_BYTES = "MLSPARK_ZERO1_BUCKET_BYTES"
 ENV_COMMS_DTYPE = "MLSPARK_COMMS_DTYPE"
+ENV_OVERLAP = "MLSPARK_ZERO1_OVERLAP"
 
 DP_MODES = ("replicated", "zero1")
 COMMS_DTYPES = ("float32", "bfloat16", "int8")
@@ -82,13 +108,31 @@ def resolve_dp_mode(dp_mode: str | None) -> str:
     return mode
 
 
+def _parse_bool(raw: str, *, env: str) -> bool:
+    lowered = raw.strip().lower()
+    if lowered in ("1", "true", "on", "yes"):
+        return True
+    if lowered in ("0", "false", "off", "no"):
+        return False
+    raise ValueError(f"{env}={raw!r} is not a boolean (use 1/0/true/false/on/off)")
+
+
 @dataclasses.dataclass(frozen=True)
 class Zero1Config:
-    """Comms-efficiency knobs for the fused ZeRO-1 step."""
+    """Comms-efficiency knobs for the fused ZeRO-1 step.
+
+    ``overlap`` selects the pipelined bucket schedule (reduce-scatter
+    issued per bucket in backward order, per-bucket update + eager
+    allgather on the tail) instead of the serial
+    flatten -> reduce-scatter-all -> update -> allgather-all barrier.
+    Both schedules are elementwise-identical; overlap only changes what
+    the XLA latency-hiding scheduler is *allowed* to run concurrently.
+    """
 
     axis: str = DATA_AXIS
     bucket_bytes: int = DEFAULT_BUCKET_BYTES
     comms_dtype: str = "float32"
+    overlap: bool = True
 
     def __post_init__(self) -> None:
         if self.comms_dtype not in COMMS_DTYPES:
@@ -109,6 +153,7 @@ class Zero1Config:
         axis: str = DATA_AXIS,
         bucket_bytes: int | None = None,
         comms_dtype: str | None = None,
+        overlap: bool | None = None,
     ) -> "Zero1Config":
         """Explicit arguments win; unset ones fall back to the launcher
         env contract, then to defaults."""
@@ -118,7 +163,15 @@ class Zero1Config:
             )
         if comms_dtype is None:
             comms_dtype = os.environ.get(ENV_COMMS_DTYPE, "float32")
-        return cls(axis=axis, bucket_bytes=bucket_bytes, comms_dtype=comms_dtype)
+        if overlap is None:
+            raw = os.environ.get(ENV_OVERLAP)
+            overlap = True if raw is None else _parse_bool(raw, env=ENV_OVERLAP)
+        return cls(
+            axis=axis,
+            bucket_bytes=bucket_bytes,
+            comms_dtype=comms_dtype,
+            overlap=overlap,
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -169,15 +222,53 @@ def make_flat_plan(params, axis_size: int, bucket_bytes: int) -> _FlatPlan:
     )
 
 
-def _flatten(tree, plan: _FlatPlan):
-    """Params/grads tree -> one fp32 vector of length ``plan.padded``."""
+def _flatten(tree, plan: _FlatPlan, constrain=None):
+    """Params/grads tree -> one fp32 vector of length ``plan.padded``.
+
+    ``constrain`` (a ``NamedSharding``) pins every raveled leaf to one
+    common sharding before the concat. The hybrid path needs this for
+    *correctness*, not placement: on jax 0.4.37/CPU, ``jnp.concatenate``
+    over 1-D operands that carry different input shardings (a mix of
+    TP-sharded and replicated leaves) miscompiles and returns permuted
+    data — the SPMD partitioner's "involuntary full rematerialization"
+    path. Constraining the operands to one sharding sidesteps it.
+    """
     leaves = jax.tree.leaves(tree)
-    flat = jnp.concatenate(
-        [jnp.ravel(l).astype(jnp.float32) for l in leaves]
-    )
+    raveled = [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+    if constrain is not None:
+        raveled = [
+            jax.lax.with_sharding_constraint(r, constrain) for r in raveled
+        ]
+    flat = raveled[0] if len(raveled) == 1 else jnp.concatenate(raveled)
     if plan.padded > plan.total:
         flat = jnp.pad(flat, (0, plan.padded - plan.total))
     return flat
+
+
+def _bucket_segment(leaves, plan: _FlatPlan, k: int):
+    """Bucket ``k``'s fp32 segment assembled straight from the leaves it
+    spans — the overlap path's replacement for ``_flatten`` + slice.
+
+    Built this way, the segment's data dependencies are exactly the grad
+    leaves inside the bucket, so its ``psum_scatter`` becomes eligible
+    the moment backward has produced *those* gradients; a full-vector
+    concat would make every bucket wait for the whole backward. The zero
+    pad always lives in the last bucket (``make_flat_plan`` guarantees
+    it), appended here explicitly.
+    """
+    s, e = plan.buckets[k]
+    parts = []
+    offset = 0
+    for leaf, size in zip(leaves, plan.sizes):
+        lo, hi = max(s, offset), min(e, offset + size)
+        if lo < hi:
+            parts.append(
+                jnp.ravel(leaf)[lo - offset:hi - offset].astype(jnp.float32)
+            )
+        offset += size
+    if e > plan.total:
+        parts.append(jnp.zeros((e - max(s, plan.total),), jnp.float32))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
 
 def _unflatten(flat, plan: _FlatPlan):
@@ -192,12 +283,13 @@ def _unflatten(flat, plan: _FlatPlan):
     return jax.tree.unflatten(plan.treedef, leaves)
 
 
-def _opt_spec_tree(opt_shapes, axis: str):
+def _opt_spec_tree(opt_shapes, axes):
     """PartitionSpecs for an optimizer state built over the flat vector:
-    vector-shaped leaves shard over ``axis``, scalars (step counts)
+    vector-shaped leaves shard over ``axes`` (one mesh axis name, or a
+    tuple of names for the hybrid joint sharding), scalars (step counts)
     replicate."""
     return jax.tree.map(
-        lambda l: P(axis) if getattr(l, "ndim", 0) >= 1 else P(), opt_shapes
+        lambda l: P(axes) if getattr(l, "ndim", 0) >= 1 else P(), opt_shapes
     )
 
 
@@ -230,20 +322,39 @@ def comms_bytes_per_step(plan: _FlatPlan, config: Zero1Config) -> dict:
     """Static wire accounting for one fused step (what the telemetry
     counters report): reduce-scatter payload in the wire dtype (+4 bytes
     per int8 bucket for the scale), allgather of the updated fp32 params.
+
+    The exposed/overlapped split is the static pipeline model, not a
+    measurement: with ``overlap=True`` and ``nb`` buckets, the pipeline
+    can hide every bucket's collective behind another bucket's compute
+    except the first reduce-scatter fill and the last allgather drain —
+    so ``(nb - 1) / nb`` of each collective's bytes count as overlapped
+    and ``1 / nb`` stays exposed. With ``overlap=False`` the schedule is
+    a barrier and every byte is exposed. ``tools/comms_bench.py`` turns
+    this into an exposed-collective-*time* estimate by scaling measured
+    standalone collective times with these fractions.
     """
     wire = _WIRE_ITEMSIZE[config.comms_dtype]
     rs = plan.padded * wire
     if config.comms_dtype == "int8":
         rs += 4 * len(plan.buckets)
+    ag = plan.padded * 4
+    nb = len(plan.buckets)
+    hidden_frac = (nb - 1) / nb if config.overlap else 0.0
+    rs_hidden = int(rs * hidden_frac)
+    ag_hidden = int(ag * hidden_frac)
     return {
         "reduce_scatter_bytes": rs,
-        "allgather_bytes": plan.padded * 4,
+        "allgather_bytes": ag,
         "grad_bytes_fp32": plan.padded * 4,
-        "n_buckets": len(plan.buckets),
+        "n_buckets": nb,
         "bucket_bytes": config.bucket_bytes,
         "comms_dtype": config.comms_dtype,
         "padded_elems": plan.padded,
         "pad_elems": plan.padded - plan.total,
+        "overlap": config.overlap,
+        "hidden_fraction": hidden_frac,
+        "bytes_overlapped": rs_hidden + ag_hidden,
+        "bytes_exposed": (rs - rs_hidden) + (ag - ag_hidden),
     }
 
 
@@ -263,7 +374,16 @@ class Zero1State(struct.PyTreeNode):
     config: Zero1Config = struct.field(pytree_node=False)
 
 
-def _require_zero1_mesh(mesh: Mesh, axis: str) -> int:
+def _require_zero1_mesh(mesh: Mesh, axis: str) -> tuple[int, int]:
+    """Validate the mesh for ``dp_mode='zero1'`` and classify its layout.
+
+    Returns ``(axis_size, model_ways)``: ``model_ways > 1`` means the
+    hybrid data x model composition (implicit sharded-update step over a
+    TP mesh); ``model_ways == 1`` is the pure data-parallel explicit
+    ``shard_map`` path. Any other >1 axis (pipeline, seq, expert) is a
+    genuinely unsupported layout for the sharded weight update — those
+    axes split the *step*, not just the placement — and raises.
+    """
     if axis not in mesh.axis_names:
         raise ValueError(
             f"zero1 needs a mesh with a {axis!r} axis; got {mesh.axis_names}"
@@ -274,14 +394,22 @@ def _require_zero1_mesh(mesh: Mesh, axis: str) -> int:
             f"zero1 needs a >1 {axis!r} axis to shard over; got {axis_size} "
             f"(mesh {dict(mesh.shape)})"
         )
-    other = {a: s for a, s in mesh.shape.items() if a != axis and s > 1}
+    model_ways = mesh.shape.get(MODEL_AXIS, 1)
+    other = {
+        a: s
+        for a, s in mesh.shape.items()
+        if a not in (axis, MODEL_AXIS) and s > 1
+    }
     if other:
         raise ValueError(
-            "dp_mode='zero1' is the pure data-parallel sharded-update path; "
-            f"mesh has extra >1 axes {other} — use shard_state(zero1=True) "
-            "for hybrid dp x tp meshes"
+            "dp_mode='zero1' shards the weight update over the data axis "
+            "and composes only with tensor parallelism on the 'model' "
+            f"axis; mesh has extra >1 axes {other}. Pipeline/sequence/"
+            "expert axes restructure the step itself — use the dedicated "
+            "paths (parallel.pipeline_parallel, ring/ulysses attention, "
+            "moe) on meshes without a zero1 data axis."
         )
-    return axis_size
+    return axis_size, model_ways
 
 
 def init_sharded(
@@ -295,25 +423,65 @@ def init_sharded(
     """Build a ``Zero1State`` whose optimizer state is sharded from the
     start: ``tx.init`` runs under ``jit(out_shardings=1/N)`` over the flat
     fp32 vector, so XLA materializes each moment directly as N shards —
-    the replicated copy never exists on any chip. Params are placed
-    replicated on the mesh (ZeRO-1 keeps whole-replica params).
+    the replicated copy never exists on any chip.
+
+    Pure data mesh: params are placed replicated (ZeRO-1 keeps
+    whole-replica params) and moments shard 1/N over the data axis.
+    Hybrid data x model mesh: params are placed per their logical TP
+    annotations (``tensor_parallel.shard_params`` — plain/unannotated
+    leaves stay replicated) and the flat moments shard jointly over
+    ``(data, model)``, so the optimizer footprint shrinks by the *full*
+    device count, not just the data ways.
     """
     config = config or Zero1Config()
-    axis_size = _require_zero1_mesh(mesh, config.axis)
-    plan = make_flat_plan(params, axis_size, config.bucket_bytes)
+    axis_size, model_ways = _require_zero1_mesh(mesh, config.axis)
+    hybrid = model_ways > 1
+    if hybrid and config.comms_dtype != "float32":
+        raise ValueError(
+            "hybrid data x model zero1 runs the implicit sharded-update "
+            "step, which cannot express a compressed wire dtype; got "
+            f"comms_dtype={config.comms_dtype!r} (use 'float32', or a "
+            "pure data mesh for bf16/int8 wire compression)"
+        )
+    import flax.linen as nn
 
+    if hybrid:
+        # Place params per their logical TP annotations (specs read off
+        # the boxed tree; plain leaves replicate), dropping any sharded
+        # dim the leaf cannot fill evenly — same policy as shard_state.
+        from machine_learning_apache_spark_tpu.parallel import (
+            tensor_parallel as _tp,
+        )
+
+        shardings_tree = _tp.mesh_shardings(params, mesh)
+        params = nn.unbox(params)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, _tp._divisible_sharding(s, x)),
+            params,
+            shardings_tree,
+        )
+    else:
+        params = nn.unbox(params)
+    # The flat vector must tile evenly over every device that holds a
+    # piece of it: N for the explicit path, N x TP for the hybrid joint
+    # sharding. (The plan's treedef is over the unboxed tree — what the
+    # step sees.)
+    plan = make_flat_plan(params, axis_size * model_ways, config.bucket_bytes)
+
+    opt_axes = (config.axis, MODEL_AXIS) if hybrid else config.axis
     flat_spec = jax.ShapeDtypeStruct((plan.padded,), jnp.float32)
     opt_shapes = jax.eval_shape(tx.init, flat_spec)
     shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        _opt_spec_tree(opt_shapes, config.axis),
+        _opt_spec_tree(opt_shapes, opt_axes),
     )
 
     @functools.partial(jax.jit, out_shardings=shardings)
     def _init():
         return tx.init(jnp.zeros((plan.padded,), jnp.float32))
 
-    params = jax.device_put(params, NamedSharding(mesh, P()))
+    if not hybrid:
+        params = jax.device_put(params, NamedSharding(mesh, P()))
     return Zero1State(
         step=0,
         params=params,
@@ -388,15 +556,19 @@ def make_zero1_step(
     plan = state.plan
     tx = state.tx
     axis = config.axis
-    axis_size = _require_zero1_mesh(mesh, axis)
-    if plan.padded % axis_size:
+    axis_size, model_ways = _require_zero1_mesh(mesh, axis)
+    if plan.padded % (axis_size * model_ways):
         raise ValueError(
             f"state plan (padded={plan.padded}) does not divide the mesh's "
-            f"{axis!r} axis ({axis_size}); the state was built for a "
-            "different mesh"
+            f"{axis!r} x model layout ({axis_size} x {model_ways}); the "
+            "state was built for a different mesh"
         )
+    if model_ways > 1:
+        step = _make_hybrid_step(loss_fn, mesh, state, grad_clip)
+        step.comms_stats = comms_bytes_per_step(plan, config)
+        return step
 
-    def per_shard(params, opt_state, batch, rng):
+    def grads_and_loss(params, batch, rng):
         idx = jax.lax.axis_index(axis)
         rng = jax.random.fold_in(rng, idx)
 
@@ -409,6 +581,13 @@ def make_zero1_step(
         )(params)
         loss = jax.lax.pmean(loss, axis)
         aux = jax.tree.map(lambda x: jax.lax.pmean(x, axis), aux)
+        return idx, grads, loss, aux
+
+    def per_shard_serial(params, opt_state, batch, rng):
+        """Barrier schedule: flatten everything, reduce-scatter every
+        bucket, one optimizer update, allgather every bucket. The
+        overlap path's bit-identity reference."""
+        idx, grads, loss, aux = grads_and_loss(params, batch, rng)
 
         # Bucketed reduce-scatter: after this, this chip holds the
         # global-mean gradient for its 1/N slice of every bucket.
@@ -460,6 +639,89 @@ def make_zero1_step(
         flat_new = jnp.concatenate(new_segments)
         return _unflatten(flat_new, plan), new_opt, loss, aux
 
+    def per_shard_overlap(params, opt_state, batch, rng):
+        """Pipelined schedule. Same elementwise math as the serial body
+        — every difference is dependency structure:
+
+        - each bucket's gradient segment comes from ``_bucket_segment``
+          (only the leaves it spans), and the ``psum_scatter``s are
+          issued in *reverse* bucket order — backward emits last-layer
+          gradients first and the flat plan is first-layer-first, so
+          reverse order lets reduce-scatter of bucket k start while
+          backward for earlier layers is still running;
+        - the optimizer update runs per bucket on that bucket's slice of
+          the flat moments, and each bucket's params ``all_gather`` is
+          issued immediately after its update — so the gather of bucket
+          k has no data dependency on the update of bucket k+1 and the
+          latency-hiding scheduler can run them concurrently.
+
+        Per-bucket slices of an elementwise optimizer chain update each
+        element exactly as the full-vector call does (scalar counts
+        increment identically in every bucket; the first bucket's copy
+        is kept), so default fp32 overlap on/off walk bit-identical
+        trajectories — the gate in tests/test_zero.py and the bench
+        equivalence section both pin it. Compressed wire dtypes and
+        ``grad_clip`` runs agree only to float tolerance (~1 ulp): their
+        cross-element reductions (bucket absmax, global norm) compile to
+        different reduction trees in the two schedules.
+        """
+        idx, grads, loss, aux = grads_and_loss(params, batch, rng)
+        grad_leaves = jax.tree.leaves(grads)
+        param_leaves = jax.tree.leaves(params)
+        n_buckets = len(plan.buckets)
+
+        g_pieces: list = [None] * n_buckets
+        for k in reversed(range(n_buckets)):
+            g_pieces[k] = _reduce_scatter_bucket(
+                _bucket_segment(grad_leaves, plan, k),
+                axis, axis_size, config.comms_dtype,
+            )
+
+        if grad_clip is not None:
+            # Same reduction shape as the serial body (sum over the
+            # concatenated shard) so clipped trajectories stay
+            # bit-identical too. The norm is a true pipeline barrier —
+            # cross-bucket coupling is what global-norm clipping means.
+            g_shard = jnp.concatenate(g_pieces)
+            g_norm = jnp.sqrt(
+                jax.lax.psum(jnp.sum(jnp.square(g_shard)), axis)
+            )
+            scale = jnp.where(g_norm < grad_clip, 1.0, grad_clip / g_norm)
+            g_pieces = [piece * scale for piece in g_pieces]
+
+        new_opt_buckets = []
+        gathered = []
+        shard_offset = 0
+        for k, (s, e) in enumerate(plan.buckets):
+            piece_len = (e - s) // axis_size
+            p_piece = jax.lax.dynamic_slice_in_dim(
+                _bucket_segment(param_leaves, plan, k),
+                idx * piece_len, piece_len,
+            )
+            opt_k = jax.tree.map(
+                lambda l: (
+                    l[shard_offset:shard_offset + piece_len]
+                    if getattr(l, "ndim", 0) >= 1 else l
+                ),
+                opt_state,
+            )
+            updates_k, new_opt_k = tx.update(g_pieces[k], opt_k, p_piece)
+            new_piece = optax.apply_updates(p_piece, updates_k)
+            gathered.append(jax.lax.all_gather(new_piece, axis, tiled=True))
+            new_opt_buckets.append(new_opt_k)
+            shard_offset += piece_len
+
+        def recombine(*bucket_leaves):
+            if getattr(bucket_leaves[0], "ndim", 0) >= 1:
+                return jnp.concatenate(bucket_leaves)
+            return bucket_leaves[0]
+
+        new_opt = jax.tree.map(recombine, *new_opt_buckets)
+        flat_new = jnp.concatenate(gathered)
+        return _unflatten(flat_new, plan), new_opt, loss, aux
+
+    per_shard = per_shard_overlap if config.overlap else per_shard_serial
+
     flat_spec = jax.ShapeDtypeStruct((plan.padded,), jnp.float32)
     opt_specs = _opt_spec_tree(jax.eval_shape(tx.init, flat_spec), axis)
     sharded = shard_map(
@@ -486,6 +748,81 @@ def make_zero1_step(
         return _step(zstate, batch, rng)
 
     step.comms_stats = comms_bytes_per_step(plan, config)
+    return step
+
+
+def _make_hybrid_step(
+    loss_fn: Callable,
+    mesh: Mesh,
+    state: Zero1State,
+    grad_clip: float | None,
+):
+    """The implicit sharded-update step for hybrid data x model meshes.
+
+    ``shard_map`` cannot express this composition on the pinned jax
+    (partial-manual mode — ``auto={'model'}`` — aborts in the SPMD
+    partitioner), so the hybrid step is a plain ``jit`` program: params
+    keep their TP placement, the flat fp32 master vector and optimizer
+    moments are constrained to ``P((data, model))``, and XLA's weight
+    update sharding compiles the reduce-scatter / shard-update /
+    allgather sequence (arxiv 2004.13336's original formulation) and
+    schedules its own comm/compute overlap.
+
+    Step semantics match ``make_train_step`` (one global-batch loss under
+    jit; no per-replica rng fold-in), which is exactly what the
+    pure-TP + replicated-DP parity reference uses.
+    """
+    config, plan, tx = state.config, state.plan, state.tx
+    flat_sharding = NamedSharding(mesh, P((config.axis, MODEL_AXIS)))
+    replicated = NamedSharding(mesh, P())
+    param_shardings = jax.tree.map(
+        lambda l: (
+            l.sharding
+            if isinstance(getattr(l, "sharding", None), NamedSharding)
+            else replicated
+        ),
+        state.params,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def _step(zstate: Zero1State, batch, rng: jax.Array):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            zstate.params, batch, rng
+        )
+        # ``constrain=replicated`` is the concat-miscompile workaround
+        # (see _flatten); the outer constraint is the actual ZeRO
+        # placement the update runs in.
+        flat_g = jax.lax.with_sharding_constraint(
+            _flatten(grads, plan, constrain=replicated), flat_sharding
+        )
+        if grad_clip is not None:
+            # True global norm (the pad is zeros) — optax
+            # clip_by_global_norm semantics, no psum needed under jit.
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(flat_g)))
+            scale = jnp.where(g_norm < grad_clip, 1.0, grad_clip / g_norm)
+            flat_g = flat_g * scale
+        flat_p = jax.lax.with_sharding_constraint(
+            _flatten(zstate.params, plan, constrain=replicated), flat_sharding
+        )
+        updates, new_opt = tx.update(flat_g, zstate.opt_state, flat_p)
+        new_flat = optax.apply_updates(flat_p, updates)
+        new_flat = jax.lax.with_sharding_constraint(new_flat, replicated)
+        new_params = jax.tree.map(
+            jax.lax.with_sharding_constraint,
+            _unflatten(new_flat, plan),
+            param_shardings,
+        )
+        return (
+            zstate.replace(
+                step=zstate.step + 1, params=new_params, opt_state=new_opt
+            ),
+            loss,
+            aux,
+        )
+
+    def step(zstate: Zero1State, batch, rng: jax.Array):
+        return _step(zstate, batch, rng)
+
     return step
 
 
@@ -521,6 +858,7 @@ __all__ = [
     "ENV_BUCKET_BYTES",
     "ENV_COMMS_DTYPE",
     "ENV_DP_MODE",
+    "ENV_OVERLAP",
     "Zero1Config",
     "Zero1State",
     "comms_bytes_per_step",

@@ -151,13 +151,31 @@ def skew_report(table: dict) -> dict:
     return report
 
 
+#: Exposed-comms fraction above which a run is called comms-bound: more
+#: than this share of (exposed-collective + step) time spent in collectives
+#: the schedule could not hide behind compute.
+COMMS_BOUND_THRESHOLD = 0.25
+
+
 def comms_report(events: list[dict], table: dict | None = None) -> dict:
     """Comms rollup for the gang report: per-rank totals of the ``comms.*``
     counter events (wire bytes the zero1 step moved, with bytes/step where
     the emitter recorded a step count in ``attrs``) plus the duration
     stats of any ``comms.*`` span phases (the collective p50/p99 the
     comms-bench emits). Empty dicts when the run had no comms activity —
-    the renderer then omits the section's tables."""
+    the renderer then omits the section's tables.
+
+    The ``overlap`` block splits the same wire bytes into overlapped vs
+    exposed (the ``comms.bytes_overlapped`` / ``comms.bytes_exposed``
+    counters the zero1 step emits — the static pipeline model, overlap on
+    hides ``(nb-1)/nb`` of each collective behind compute). ``verdict``
+    mirrors the ingest input-bound verdict: exposed-collective time —
+    measured ``comms.*`` span time scaled by the exposed byte fraction —
+    as a share of exposed + ``train.step`` time, comms-bound above
+    ``COMMS_BOUND_THRESHOLD``. ``None`` when the run recorded no
+    ``comms.*`` spans (a fused training step cannot time its in-program
+    collectives; only the bench's standalone collectives produce spans).
+    """
     table = phase_table(events) if table is None else table
     counters: dict[str, dict] = {}
     for ev in events:
@@ -176,6 +194,52 @@ def comms_report(events: list[dict], table: dict | None = None) -> dict:
                 round(entry["total"] / entry["steps"], 1)
                 if entry["steps"] else None
             )
+    collectives = {
+        phase: entry
+        for phase, entry in table.items()
+        if phase.startswith("comms.")
+    }
+
+    def _counter_total(name: str) -> float:
+        return sum(
+            entry["total"] for entry in counters.get(name, {}).values()
+        )
+
+    overlap: dict = {}
+    exposed_b = _counter_total("comms.bytes_exposed")
+    overlapped_b = _counter_total("comms.bytes_overlapped")
+    if exposed_b or overlapped_b:
+        wire = exposed_b + overlapped_b
+        overlap = {
+            "bytes_exposed": int(exposed_b),
+            "bytes_overlapped": int(overlapped_b),
+            "overlapped_fraction": round(overlapped_b / wire, 4) if wire else None,
+        }
+
+    def _phase_total(phase: str) -> float:
+        entry = table.get(phase)
+        if not entry:
+            return 0.0
+        return entry["overall"]["mean"] * entry["overall"]["count"]
+
+    comms_time = sum(_phase_total(phase) for phase in collectives)
+    exposed_fraction_of_bytes = (
+        exposed_b / (exposed_b + overlapped_b)
+        if (exposed_b + overlapped_b) > 0 else 1.0
+    )
+    exposed_time = comms_time * exposed_fraction_of_bytes
+    step_time = _phase_total("train.step") + _phase_total("train.step_group")
+    comms_fraction = (
+        round(exposed_time / (exposed_time + step_time), 4)
+        if (exposed_time + step_time) > 0 and comms_time > 0 else None
+    )
+    verdict = None
+    if comms_fraction is not None and step_time > 0:
+        verdict = (
+            "comms-bound"
+            if comms_fraction > COMMS_BOUND_THRESHOLD
+            else "compute-bound"
+        )
     return {
         "counters": {
             name: dict(sorted(
@@ -183,11 +247,10 @@ def comms_report(events: list[dict], table: dict | None = None) -> dict:
             ))
             for name, per_rank in sorted(counters.items())
         },
-        "collectives": {
-            phase: entry
-            for phase, entry in table.items()
-            if phase.startswith("comms.")
-        },
+        "collectives": collectives,
+        "overlap": overlap,
+        "comms_fraction": comms_fraction,
+        "verdict": verdict,
     }
 
 
@@ -474,6 +537,20 @@ def render_markdown(report: dict) -> str:
     comms = report.get("comms") or {}
     if comms.get("counters") or comms.get("collectives"):
         lines += ["", "## Comms", ""]
+        if comms.get("verdict"):
+            lines.append(
+                f"- verdict: **{comms['verdict']}** "
+                f"(exposed-comms fraction {comms['comms_fraction']})"
+            )
+            lines.append("")
+        if comms.get("overlap"):
+            ov = comms["overlap"]
+            lines.append(
+                f"- overlap: {ov['bytes_overlapped']} bytes hidden behind "
+                f"compute, {ov['bytes_exposed']} exposed "
+                f"(overlapped fraction {ov['overlapped_fraction']})"
+            )
+            lines.append("")
         if comms.get("counters"):
             lines.append("| counter | rank | total bytes | steps | bytes/step |")
             lines.append("|---|---|---|---|---|")
@@ -650,6 +727,7 @@ def render_status_markdown(rows: list[dict]) -> str:
 
 
 __all__ = [
+    "COMMS_BOUND_THRESHOLD",
     "INPUT_BOUND_THRESHOLD",
     "REQUEST_REPORT_SLOWEST",
     "comms_report",

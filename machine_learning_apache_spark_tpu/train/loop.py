@@ -142,12 +142,16 @@ def _with_comms_counters(zstep, state):
     )
     rs = reg.counter("comms", "bytes_reduce_scattered")
     ag = reg.counter("comms", "bytes_allgathered")
+    exposed = reg.counter("comms", "bytes_exposed")
+    overlapped = reg.counter("comms", "bytes_overlapped")
     counted = [0]
 
     def step(st, batch, rng):
         out = zstep(st, batch, rng)
         rs.inc(stats["reduce_scatter_bytes"])
         ag.inc(stats["allgather_bytes"])
+        exposed.inc(stats["bytes_exposed"])
+        overlapped.inc(stats["bytes_overlapped"])
         counted[0] += 1
         return out
 
@@ -155,15 +159,31 @@ def _with_comms_counters(zstep, state):
         if not counted[0]:
             return
         log_ = telemetry.get_log()
+        common = {
+            "steps": counted[0],
+            "comms_dtype": stats["comms_dtype"],
+            "overlap": stats["overlap"],
+        }
         log_.emit(
             "counter", "comms.bytes_reduce_scattered",
-            value=counted[0] * stats["reduce_scatter_bytes"],
-            attrs={"steps": counted[0], "comms_dtype": stats["comms_dtype"]},
+            value=counted[0] * stats["reduce_scatter_bytes"], attrs=common,
         )
         log_.emit(
             "counter", "comms.bytes_allgathered",
-            value=counted[0] * stats["allgather_bytes"],
-            attrs={"steps": counted[0], "comms_dtype": stats["comms_dtype"]},
+            value=counted[0] * stats["allgather_bytes"], attrs=common,
+        )
+        # The exposed/overlapped split of the same wire bytes — the static
+        # pipeline model from comms_bytes_per_step (overlap on: 1/nb of
+        # each collective exposed at the pipeline fill/drain; off: all of
+        # it). telemetry_report's comms section turns these into the
+        # comms-bound/compute-bound verdict inputs.
+        log_.emit(
+            "counter", "comms.bytes_exposed",
+            value=counted[0] * stats["bytes_exposed"], attrs=common,
+        )
+        log_.emit(
+            "counter", "comms.bytes_overlapped",
+            value=counted[0] * stats["bytes_overlapped"], attrs=common,
         )
         counted[0] = 0
 
@@ -192,6 +212,7 @@ def fit(
     dp_mode: str | None = None,
     dp_bucket_bytes: int | None = None,
     dp_comms_dtype: str | None = None,
+    dp_overlap: bool | None = None,
     steps_per_call: int = 1,
     prefetch_to_device: int = 0,
     resume: bool = False,
@@ -244,9 +265,18 @@ def fit(
     allgather back. Same trajectory as the replicated step (bit-identical
     with the default fp32 comms). ``dp_bucket_bytes`` /
     ``dp_comms_dtype`` (env ``MLSPARK_ZERO1_BUCKET_BYTES`` /
-    ``MLSPARK_COMMS_DTYPE``) tune the gradient collective — see
-    docs/PARALLELISM.md for the tradeoffs. Distinct from the legacy
-    ``zero1=True`` flag (implicit opt-state sharding, replicated step).
+    ``MLSPARK_COMMS_DTYPE``) tune the gradient collective;
+    ``dp_overlap`` (env ``MLSPARK_ZERO1_OVERLAP``, default on) selects
+    the pipelined bucket schedule that hides the reduce-scatter behind
+    backward and the params allgather behind the per-bucket optimizer
+    updates — see docs/PARALLELISM.md for the tradeoffs. On a hybrid
+    ``data x model`` mesh (``parallel.make_mesh({"data": D, "model":
+    T})``) the ZeRO-1 update composes with tensor parallelism: params
+    keep their logical TP placement, the flat optimizer moments shard
+    over all D x T devices, and the step runs the implicit
+    weight-update-sharding form (fp32 comms only). Distinct from the
+    legacy ``zero1=True`` flag (implicit opt-state sharding, replicated
+    step).
 
     ``steps_per_call=K`` dispatches K batches per host→device call via a
     ``lax.scan``-fused step (``make_multi_step``) — same math, same rng
@@ -316,9 +346,14 @@ def fit(
                 "dp_mode='zero1' runs its own fused step; steps_per_call "
                 "fusion is not supported with it"
             )
-    elif dp_bucket_bytes is not None or dp_comms_dtype is not None:
+    elif (
+        dp_bucket_bytes is not None
+        or dp_comms_dtype is not None
+        or dp_overlap is not None
+    ):
         raise ValueError(
-            "dp_bucket_bytes/dp_comms_dtype only apply to dp_mode='zero1'"
+            "dp_bucket_bytes/dp_comms_dtype/dp_overlap only apply to "
+            "dp_mode='zero1'"
         )
     step_fn = make_train_step(loss_fn)
     multi_fn = make_multi_step(loss_fn) if steps_per_call > 1 else None
@@ -327,7 +362,9 @@ def fit(
     )
     if mesh is not None and mode == "zero1":
         config = _zero.Zero1Config.from_env(
-            bucket_bytes=dp_bucket_bytes, comms_dtype=dp_comms_dtype
+            bucket_bytes=dp_bucket_bytes,
+            comms_dtype=dp_comms_dtype,
+            overlap=dp_overlap,
         )
         state = _zero.shard_optimizer_state(state, mesh, config)
         step_fn = _with_comms_counters(

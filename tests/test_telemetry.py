@@ -374,7 +374,13 @@ class TestCommsReport:
 
     def test_section_absent_without_comms_events(self, two_rank_dir):
         report = aggregate.merge_gang_dir(two_rank_dir)
-        assert report["comms"] == {"counters": {}, "collectives": {}}
+        assert report["comms"] == {
+            "counters": {},
+            "collectives": {},
+            "overlap": {},
+            "comms_fraction": None,
+            "verdict": None,
+        }
         assert "## Comms" not in aggregate.render_markdown(report)
 
 

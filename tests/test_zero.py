@@ -3,9 +3,12 @@
 The contract under test is the one the rewrite is sold on (arxiv
 2004.13336): reduce-scatter + shard-local update + allgather is the SAME
 optimizer trajectory as replicated data parallelism — bit-identical with
-fp32 comms — while each chip holds only 1/N of the optimizer state. Plus
-the fit() wiring, the env contract, the guard rails, and the telemetry
-glue the comms report reads.
+fp32 comms, in BOTH the pipelined (overlap) and serial bucket schedules
+— while each chip holds only 1/N of the optimizer state. Hybrid
+``data x model`` meshes compose ZeRO-1 with tensor parallelism and must
+train to parity with the pure-TP + replicated-DP reference. Plus the
+fit() wiring, the env contract, the guard rails, and the telemetry glue
+the comms report reads.
 """
 
 import json
@@ -24,7 +27,9 @@ from machine_learning_apache_spark_tpu import telemetry
 from machine_learning_apache_spark_tpu.models import MLP
 from machine_learning_apache_spark_tpu.parallel import (
     DATA_AXIS,
+    MODEL_AXIS,
     assert_replicas_in_sync,
+    data_model_mesh,
     data_parallel_mesh,
     make_data_parallel_step,
     make_mesh,
@@ -32,12 +37,16 @@ from machine_learning_apache_spark_tpu.parallel import (
     shard_batch,
     zero,
 )
+from machine_learning_apache_spark_tpu.parallel.tensor_parallel import (
+    shard_state,
+)
 from machine_learning_apache_spark_tpu.telemetry import registry
 from machine_learning_apache_spark_tpu.train import (
     TrainState,
     classification_loss,
     fit,
     make_optimizer,
+    make_train_step,
 )
 
 pytestmark = pytest.mark.comms
@@ -112,6 +121,38 @@ class TestZero1Equivalence:
             lambda a, b: np.testing.assert_array_equal(a, b), rep, z
         )
 
+    def test_serial_schedule_bit_identical_multi_bucket(self, rng):
+        # overlap=False is the barrier schedule — the pipelined default
+        # above must not be the only path that matches the reference.
+        rep, z = self._pair(
+            rng, data_parallel_mesh(), bucket_bytes=64, overlap=False
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(a, b), rep, z
+        )
+
+    def test_overlap_on_off_bit_identical_trajectory(self, rng):
+        # Direct pipelined-vs-serial comparison: same 6-step trajectory,
+        # 64-byte buckets so every step crosses several bucket seams.
+        # The overlap schedule only changes dependency structure, so fp32
+        # must match element-for-element, bit-for-bit.
+        model, new_state, batch = _setup(rng)
+        mesh = data_parallel_mesh()
+        loss_fn = classification_loss(model.apply)
+        out = {}
+        for ov in (True, False):
+            zstate = _zero1_state(
+                new_state, mesh, bucket_bytes=64, overlap=ov
+            )
+            out[ov], _ = _trajectory(
+                zero.make_zero1_step(loss_fn, mesh, zstate), zstate, mesh,
+                batch, steps=6,
+            )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            out[True], out[False],
+        )
+
     def test_bf16_comms_close(self, rng):
         rep, z = self._pair(
             rng, data_parallel_mesh(), comms_dtype="bfloat16"
@@ -148,6 +189,105 @@ class TestZero1Equivalence:
         assert sharded_leaves, "no opt-state leaf is actually sharded"
 
 
+class TestHybridMesh:
+    """ZeRO-1 x TP composition on a 2-D ``data x model`` mesh (2x4 on
+    the 8-device CPU mesh). The reference is pure TP + replicated DP:
+    ``shard_state`` placement + the plain jitted ``make_train_step`` —
+    the hybrid step has the same global-batch semantics, so the
+    trajectories agree to fp32 reduction-order tolerance."""
+
+    def _hybrid_setup(self, rng):
+        feats = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 4, 16))
+        # Widths divisible by the 4-way model axis so the TP annotations
+        # actually shard (MLP alternates ("embed","mlp")/("mlp","embed")).
+        model = MLP(layers=(4, 8, 8, 4), tp_rules=True)
+        params = model.init(jax.random.key(0), feats[:1])["params"]  # boxed
+        return model, params, (feats, labels)
+
+    def _run(self, step, state, mesh, batch, steps=5):
+        sharded = shard_batch(mesh, batch)
+        for i in range(steps):
+            state, loss, _ = step(
+                state, sharded, jax.random.fold_in(jax.random.key(9), i)
+            )
+        return state, float(loss)
+
+    def test_hybrid_matches_tp_reference(self, rng):
+        model, params, batch = self._hybrid_setup(rng)
+        mesh = data_model_mesh(4)
+        assert dict(mesh.shape) == {DATA_AXIS: 2, MODEL_AXIS: 4}
+        loss_fn = classification_loss(model.apply)
+
+        ref = shard_state(
+            TrainState.create(
+                apply_fn=model.apply,
+                params=jax.tree.map(jnp.copy, params),
+                tx=make_optimizer("adam", 1e-2),
+            ),
+            mesh,
+        )
+        ref, ref_loss = self._run(make_train_step(loss_fn), ref, mesh, batch)
+        replicated_bytes = zero.opt_state_bytes(ref.opt_state)
+
+        zstate = zero.init_sharded(
+            apply_fn=model.apply,
+            params=jax.tree.map(jnp.copy, params),
+            tx=make_optimizer("adam", 1e-2),
+            mesh=mesh,
+            config=zero.Zero1Config(bucket_bytes=64),  # multi-bucket
+        )
+        zstep = zero.make_zero1_step(loss_fn, mesh, zstate)
+        zstate, z_loss = self._run(zstep, zstate, mesh, batch)
+
+        assert z_loss == pytest.approx(ref_loss, abs=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            jax.device_get(ref.params), jax.device_get(zstate.params),
+        )
+        # Satellite acceptance: per-chip optimizer bytes <= 1/N + ε of
+        # the replicated footprint — N is the FULL device count (the
+        # flat moments shard jointly over data x model).
+        per_chip = zero.opt_state_bytes_per_chip(zstate)
+        assert per_chip <= replicated_bytes * (1 / N) + 64
+        # TP placement survives the flatten/update/unflatten round trip.
+        kernel_specs = [
+            str(getattr(leaf.sharding, "spec", ""))
+            for leaf in jax.tree.leaves(zstate.params)
+        ]
+        assert any(MODEL_AXIS in s for s in kernel_specs)
+        # And the step carries the byte accounting fit's counters read.
+        assert zstep.comms_stats["reduce_scatter_bytes"] > 0
+
+    def test_hybrid_via_fit(self, rng):
+        model, params, (feats, labels) = self._hybrid_setup(rng)
+        batches = [(feats[i : i + 8], labels[i : i + 8]) for i in (0, 8)]
+        state = TrainState.create(
+            apply_fn=model.apply,
+            params=jax.tree.map(jnp.copy, params),
+            tx=make_optimizer("adam", 1e-2),
+        )
+        res = fit(
+            state, classification_loss(model.apply), batches,
+            mesh=data_model_mesh(4), dp_mode="zero1", dp_bucket_bytes=256,
+            epochs=2, log_every=0, rng=jax.random.key(3),
+            emit=lambda s: None,
+        )
+        assert isinstance(res.state, zero.Zero1State)
+        assert np.isfinite(res.final_loss)
+
+    def test_hybrid_rejects_compressed_wire(self, rng):
+        model, params, _ = self._hybrid_setup(rng)
+        with pytest.raises(ValueError, match="hybrid"):
+            zero.init_sharded(
+                apply_fn=model.apply, params=params,
+                tx=make_optimizer("adam", 1e-2), mesh=data_model_mesh(4),
+                config=zero.Zero1Config(comms_dtype="int8"),
+            )
+
+
 class TestFitWiring:
     def _batches(self, feats, labels):
         return [
@@ -175,12 +315,21 @@ class TestFitWiring:
         monkeypatch.setenv(zero.ENV_DP_MODE, "zero1")
         monkeypatch.setenv(zero.ENV_BUCKET_BYTES, "128")
         monkeypatch.setenv(zero.ENV_COMMS_DTYPE, "bfloat16")
+        monkeypatch.setenv(zero.ENV_OVERLAP, "off")
         assert zero.resolve_dp_mode(None) == "zero1"
         cfg = zero.Zero1Config.from_env()
         assert cfg.bucket_bytes == 128 and cfg.comms_dtype == "bfloat16"
+        assert cfg.overlap is False
         # Explicit argument beats env:
         assert zero.resolve_dp_mode("replicated") == "replicated"
         assert zero.Zero1Config.from_env(bucket_bytes=256).bucket_bytes == 256
+        assert zero.Zero1Config.from_env(overlap=True).overlap is True
+        # Unset env -> pipelined default; junk value -> loud error.
+        monkeypatch.delenv(zero.ENV_OVERLAP)
+        assert zero.Zero1Config.from_env().overlap is True
+        monkeypatch.setenv(zero.ENV_OVERLAP, "maybe")
+        with pytest.raises(ValueError, match=zero.ENV_OVERLAP):
+            zero.Zero1Config.from_env()
         # (fit picking the mode up from env alone is exercised — together
         # with the telemetry counters — in TestTelemetryGlue, sharing one
         # compiled fit instead of paying for two.)
@@ -207,6 +356,11 @@ class TestFitWiring:
                 new_state(), loss_fn, batches, mesh=data_parallel_mesh(),
                 dp_comms_dtype="bfloat16", **kw
             )
+        with pytest.raises(ValueError, match="zero1"):
+            fit(
+                new_state(), loss_fn, batches, mesh=data_parallel_mesh(),
+                dp_overlap=False, **kw
+            )
 
 
 class TestGuards:
@@ -216,10 +370,15 @@ class TestGuards:
         with pytest.raises(ValueError, match="step"):
             zero.shard_optimizer_state(state, data_parallel_mesh())
 
-    def test_hybrid_mesh_raises(self, rng):
+    def test_pipeline_mesh_raises(self, rng):
+        # Hybrid data x model now composes (TestHybridMesh); a pipeline
+        # axis restructures the step itself and must still refuse, with
+        # an error that names the supported composition.
         model, new_state, _ = _setup(rng)
-        mesh = make_mesh({DATA_AXIS: 4, "model": 2})
-        with pytest.raises(ValueError, match="hybrid"):
+        mesh = make_mesh({DATA_AXIS: 4, "pipeline": 2})
+        with pytest.raises(
+            ValueError, match="composes only with tensor parallelism"
+        ):
             zero.shard_optimizer_state(new_state(), mesh)
 
     def test_step_requires_zero1_state(self, rng):
@@ -289,16 +448,27 @@ class TestTelemetryGlue:
             ]
             assert {e["name"] for e in evs} == {
                 "comms.bytes_reduce_scattered", "comms.bytes_allgathered",
+                "comms.bytes_exposed", "comms.bytes_overlapped",
             }
             # 2 epochs × 4 batches, stamped so the report can do bytes/step.
             assert all(e["attrs"]["steps"] == 8 for e in evs)
+            assert all(e["attrs"]["overlap"] is True for e in evs)
+            by_name = {e["name"]: e["value"] for e in evs}
+            # The overlapped/exposed split partitions the wire bytes.
+            assert by_name["comms.bytes_exposed"] + by_name[
+                "comms.bytes_overlapped"
+            ] == by_name["comms.bytes_reduce_scattered"] + by_name[
+                "comms.bytes_allgathered"
+            ]
         finally:
             telemetry.reset()
 
 
 def test_comms_bench_smoke_subprocess(tmp_path):
     """tools/comms_bench.py --smoke is the tier-1 CI entry: a fresh
-    process, the 2-point sweep, and the full equivalence gate."""
+    process, a small sweep covering overlap on/off plus the hybrid leg,
+    and the full equivalence gate (replicated parity AND overlap
+    bit-identity)."""
     out = tmp_path / "comms_bench.json"
     r = subprocess.run(
         [
@@ -313,8 +483,24 @@ def test_comms_bench_smoke_subprocess(tmp_path):
     art = json.loads(out.read_text())
     assert art["ok"] is True
     assert art["equivalence"]["bit_identical_float32"] is True
+    assert art["equivalence"]["bit_identical_overlap_fp32"] is True
     assert art["equivalence"]["opt_state_ok"] is True
-    assert [p["mode"] for p in art["sweep"]] == ["replicated", "zero1"]
+    assert [p["mode"] for p in art["sweep"]] == [
+        "replicated", "zero1", "zero1",
+    ]
+    zero1_points = [p for p in art["sweep"] if p["mode"] == "zero1"]
+    assert {p["overlap"] for p in zero1_points} == {True, False}
+    # The column the overlap win is read off: pipelining leaves only
+    # 1/n_buckets of the standalone collective time exposed.
+    on = next(p for p in zero1_points if p["overlap"])
+    off = next(p for p in zero1_points if not p["overlap"])
+    assert on["n_buckets"] > 1
+    assert on["exposed_collective_ms_est"] < off["exposed_collective_ms_est"]
+    assert off["hidden_fraction"] == 0.0
+    # Hybrid leg: parity with the pure-TP reference + sharded moments.
+    assert art["hybrid"]["ok"] is True
+    assert art["hybrid"]["parity_ok"] is True
+    assert art["hybrid"]["tp_sharding_preserved"] is True
     assert art["comms"]["collectives"].keys() >= {
         "comms.reduce_scatter", "comms.allgather",
     }

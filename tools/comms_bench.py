@@ -7,16 +7,27 @@ Sweeps the data-parallel update path on the virtual 8-device CPU mesh
 - ``replicated`` — ``make_data_parallel_step`` (full-gradient allreduce,
   replicated optimizer state);
 - ``zero1`` — ``parallel.zero.make_zero1_step`` (bucketed reduce-scatter
-  → 1/N sharded update → allgather) across bucket sizes and comms dtypes
-  (fp32 / bf16 / int8-with-per-bucket-scale).
+  → 1/N sharded update → allgather) across bucket sizes, comms dtypes
+  (fp32 / bf16 / int8-with-per-bucket-scale), and the ``overlap`` knob
+  (pipelined bucket schedule on/off);
+- ``zero1-hybrid`` — the same fused step on a 2-D ``data x model`` mesh
+  composing ZeRO-1 with tensor parallelism, checked to parity against a
+  pure-TP + replicated-DP reference (``shard_state`` +
+  ``make_train_step``).
+
+Each zero1 sweep point carries an ``exposed_collective_ms_est`` column:
+the standalone measured reduce-scatter + allgather time scaled by the
+static exposed fraction from ``zero.comms_bytes_per_step`` (1/n_buckets
+with overlap on, 1.0 with overlap off) — the number that makes the
+overlap win legible instead of buried in a fused step time.
 
 Besides the throughput sweep it records the PR's acceptance evidence:
 the ZeRO-1 trajectory-equivalence check against the replicated step
-(bit-identity for fp32 comms, max-abs-diff for the lossy dtypes) and the
-per-chip optimizer-state-bytes ratio (≈ 1/N of replicated). Collective
-phases run standalone under ``comms.reduce_scatter``/``comms.allgather``
-telemetry spans so the artifact (and any merged gang report) carries
-their p50/p99.
+(bit-identity for fp32 comms — in BOTH overlap modes — max-abs-diff for
+the lossy dtypes) and the per-chip optimizer-state-bytes ratio (≈ 1/N
+of replicated). Collective phases run standalone under
+``comms.reduce_scatter``/``comms.allgather`` telemetry spans so the
+artifact (and any merged gang report) carries their p50/p99.
 
 Writes one JSON artifact (``--out``, default stdout). ``--smoke`` is the
 tier-1 CI configuration: a 2-point sweep with tiny step counts, seconds
@@ -57,6 +68,8 @@ from machine_learning_apache_spark_tpu import telemetry  # noqa: E402
 from machine_learning_apache_spark_tpu.models import MLP  # noqa: E402
 from machine_learning_apache_spark_tpu.parallel import (  # noqa: E402
     DATA_AXIS,
+    MODEL_AXIS,
+    data_model_mesh,
     make_mesh,
 )
 from machine_learning_apache_spark_tpu.parallel import zero  # noqa: E402
@@ -64,7 +77,13 @@ from machine_learning_apache_spark_tpu.parallel.data_parallel import (  # noqa: 
     make_data_parallel_step,
 )
 from machine_learning_apache_spark_tpu.parallel.mesh import shard_batch  # noqa: E402
+from machine_learning_apache_spark_tpu.parallel.tensor_parallel import (  # noqa: E402
+    shard_state,
+)
 from machine_learning_apache_spark_tpu.telemetry import aggregate  # noqa: E402
+from machine_learning_apache_spark_tpu.train.loop import (  # noqa: E402
+    make_train_step,
+)
 from machine_learning_apache_spark_tpu.train.state import (  # noqa: E402
     TrainState,
     make_optimizer,
@@ -77,11 +96,12 @@ from jax.sharding import PartitionSpec as P  # noqa: E402
 WIDTH = 256  # ~100k params with the in/out stems: enough for real buckets
 
 
-def _workload():
+def _workload(tp_rules: bool = False):
     """Deterministic regression workload: MLP(64→256→256→64), fixed
     batches. Everything derives from fixed seeds so every mode sees the
-    identical trajectory inputs."""
-    model = MLP(layers=(64, WIDTH, WIDTH, 64))
+    identical trajectory inputs. ``tp_rules=True`` annotates the kernels
+    with logical TP axes (boxed params) for the hybrid-mesh leg."""
+    model = MLP(layers=(64, WIDTH, WIDTH, 64), tp_rules=tp_rules)
     params0 = model.init(jax.random.key(0), jnp.ones((8, 64)))["params"]
 
     def loss_fn(params, batch, rng):
@@ -149,9 +169,13 @@ def _max_diff(a, b) -> float:
 
 def equivalence_check(mesh, steps: int, dtypes=zero.COMMS_DTYPES) -> dict:
     """N-step trajectory parity: zero1(fp32) must be bit-identical to the
-    replicated step; bf16/int8 report their drift. Plus the per-chip
-    optimizer-memory ratio the ZeRO-1 rewrite exists for. ``dtypes`` must
-    include float32 (the gate); smoke passes just that one."""
+    replicated step in BOTH overlap modes (the pipelined schedule is
+    elementwise-identical to the serial barrier, so overlap on/off must
+    also match each other bit-for-bit); bf16/int8 report their drift.
+    Plus the per-chip optimizer-memory ratio the ZeRO-1 rewrite exists
+    for. ``dtypes`` must include float32 (the gate); smoke passes just
+    that one. Bucket size 65536 keeps several buckets in play so the
+    bit-identity check crosses bucket seams."""
     model, params0, loss_fn, batch_at = _workload()
     tx = make_optimizer("adam", 1e-2)
     batches = [batch_at(i) for i in range(steps)]
@@ -164,6 +188,7 @@ def equivalence_check(mesh, steps: int, dtypes=zero.COMMS_DTYPES) -> dict:
     n = mesh.shape[DATA_AXIS]
     out: dict = {"steps": steps, "n_devices": int(n)}
     per_chip = None
+    fp32_params = None
     for dtype in dtypes:
         cfg = zero.Zero1Config(bucket_bytes=65536, comms_dtype=dtype)
         z, _ = _run_zero1(
@@ -174,6 +199,18 @@ def equivalence_check(mesh, steps: int, dtypes=zero.COMMS_DTYPES) -> dict:
         if dtype == "float32":
             out["bit_identical_float32"] = diff == 0.0
             per_chip = zero.opt_state_bytes_per_chip(z)
+            fp32_params = jax.device_get(z.params)
+    # The serial barrier schedule (overlap=False) against the pipelined
+    # default: same trajectory, bit for bit.
+    cfg_off = zero.Zero1Config(
+        bucket_bytes=65536, comms_dtype="float32", overlap=False
+    )
+    z_off, _ = _run_zero1(
+        mesh, model, params0, loss_fn, tx, batches, rngs, cfg_off
+    )
+    diff_off = _max_diff(fp32_params, jax.device_get(z_off.params))
+    out["max_abs_diff_overlap_off_vs_on"] = diff_off
+    out["bit_identical_overlap_fp32"] = diff_off == 0.0
     ratio = per_chip / replicated_bytes
     bound = 1.0 / n + 0.01  # ε: pad tail + replicated step-count scalars
     out.update(
@@ -183,7 +220,11 @@ def equivalence_check(mesh, steps: int, dtypes=zero.COMMS_DTYPES) -> dict:
         opt_state_bound=round(bound, 5),
         opt_state_ok=ratio <= bound,
     )
-    out["ok"] = bool(out["bit_identical_float32"] and out["opt_state_ok"])
+    out["ok"] = bool(
+        out["bit_identical_float32"]
+        and out["bit_identical_overlap_fp32"]
+        and out["opt_state_ok"]
+    )
     return out
 
 
@@ -212,7 +253,15 @@ def bench_point(mesh, mode: str, steps: int, config=None) -> dict:
             opt_state_bytes_per_chip=zero.opt_state_bytes_per_chip(state),
             **{
                 k: step.comms_stats[k]
-                for k in ("reduce_scatter_bytes", "allgather_bytes", "n_buckets")
+                for k in (
+                    "reduce_scatter_bytes",
+                    "allgather_bytes",
+                    "n_buckets",
+                    "overlap",
+                    "hidden_fraction",
+                    "bytes_overlapped",
+                    "bytes_exposed",
+                )
             },
         )
     for _ in range(2):  # compile + settle
@@ -232,10 +281,12 @@ def bench_point(mesh, mode: str, steps: int, config=None) -> dict:
     return point
 
 
-def bench_collectives(mesh, config, reps: int) -> None:
+def bench_collectives(mesh, config, reps: int) -> dict:
     """Standalone reduce-scatter / allgather timings under telemetry spans
     — inside the fused step XLA overlaps them with compute, so the span
-    p50/p99 the report wants has to come from separately-jitted phases."""
+    p50/p99 the report wants has to come from separately-jitted phases.
+    Returns the mean per-phase milliseconds; ``main`` scales them by the
+    static exposed fraction into ``exposed_collective_ms_est``."""
     axis = config.axis
     n = mesh.shape[axis]
     model, params0, _, _ = _workload()
@@ -276,11 +327,118 @@ def bench_collectives(mesh, config, reps: int) -> None:
         "comms_dtype": config.comms_dtype,
         "n_buckets": len(plan.buckets),
     }
+    rs_ms, ag_ms = [], []
     for _ in range(reps):
+        t0 = time.perf_counter()
         with telemetry.span("comms.reduce_scatter", **attrs):
             jax.block_until_ready(rs(flat))
+        t1 = time.perf_counter()
         with telemetry.span("comms.allgather", **attrs):
             jax.block_until_ready(ag(shard))
+        t2 = time.perf_counter()
+        rs_ms.append((t1 - t0) * 1e3)
+        ag_ms.append((t2 - t1) * 1e3)
+    return {
+        "reduce_scatter_ms": sum(rs_ms) / len(rs_ms),
+        "allgather_ms": sum(ag_ms) / len(ag_ms),
+    }
+
+
+def bench_hybrid(steps: int) -> dict:
+    """The hybrid ``data x model`` leg: ZeRO-1 composed with tensor
+    parallelism on a 2-D mesh, checked against the pure-TP +
+    replicated-DP reference (``shard_state`` + ``make_train_step``).
+    Both steps compute one global-batch loss under jit, so the
+    trajectories agree to float32 reduction-order tolerance — parity,
+    not bit-identity (the fp32 bit-identity gate is the pure-mesh one).
+    """
+    n = jax.device_count()
+    model_ways = 4 if n % 4 == 0 and n >= 8 else 2
+    if n % model_ways or n // model_ways < 2:
+        return {"skipped": f"need a 2-D mesh, got {n} devices", "ok": True}
+    mesh = data_model_mesh(model_ways)
+    model, params0, loss_fn, batch_at = _workload(tp_rules=True)
+    tx = make_optimizer("adam", 1e-2)
+    batches = [batch_at(i) for i in range(steps)]
+    rngs = [jax.random.fold_in(jax.random.key(7), i) for i in range(steps)]
+
+    # Pure-TP + replicated-DP reference: logical-rule placement on the
+    # same mesh, plain jitted train step (replicated optimizer state).
+    ref = shard_state(
+        TrainState.create(
+            apply_fn=model.apply,
+            params=jax.tree.map(jnp.copy, params0),
+            tx=tx,
+        ),
+        mesh,
+    )
+    ref_step = make_train_step(loss_fn)
+    for b, r in zip(batches, rngs):
+        ref, _, _ = ref_step(ref, shard_batch(mesh, b), r)
+    jax.block_until_ready(ref.params)
+    replicated_bytes = zero.opt_state_bytes(ref.opt_state)
+
+    cfg = zero.Zero1Config(bucket_bytes=65536)
+    state = zero.init_sharded(
+        apply_fn=model.apply,
+        params=jax.tree.map(jnp.copy, params0),
+        tx=tx,
+        mesh=mesh,
+        config=cfg,
+    )
+    step = zero.make_zero1_step(loss_fn, mesh, state)
+    for b, r in zip(batches, rngs):
+        state, loss, _ = step(state, shard_batch(mesh, b), r)
+    jax.block_until_ready(state.params)
+
+    diff = _max_diff(
+        jax.device_get(ref.params), jax.device_get(state.params)
+    )
+    per_chip = zero.opt_state_bytes_per_chip(state)
+    ratio = per_chip / replicated_bytes
+    bound = 1.0 / n + 0.01
+    # TP placement must survive the flatten/update/unflatten round trip:
+    # the wide kernels stay model-sharded after every step.
+    tp_sharded = any(
+        MODEL_AXIS in str(getattr(leaf.sharding, "spec", ""))
+        for leaf in jax.tree.leaves(state.params)
+    )
+
+    batch = shard_batch(mesh, batch_at(0))
+    rng = jax.random.key(3)
+    for _ in range(2):  # settle after the trajectory run
+        state, loss, _ = step(state, batch, rng)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss, _ = step(state, batch, rng)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    out = {
+        "mode": "zero1-hybrid",
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "steps": steps,
+        "max_abs_diff_vs_tp_reference": diff,
+        "parity_ok": diff <= 1e-5,
+        "tp_sharding_preserved": bool(tp_sharded),
+        "opt_state_bytes_per_chip": per_chip,
+        "replicated_opt_state_bytes": replicated_bytes,
+        "opt_state_ratio": round(ratio, 5),
+        "opt_state_bound": round(bound, 5),
+        "opt_state_ok": ratio <= bound,
+        "steps_per_sec": round(steps / dt, 2),
+        "step_ms": round(dt / steps * 1e3, 3),
+        "loss": round(float(loss), 4),
+        "bucket_bytes": cfg.bucket_bytes,
+        "comms_dtype": cfg.comms_dtype,
+    }
+    out["ok"] = bool(
+        out["parity_ok"]
+        and out["opt_state_ok"]
+        and out["tp_sharding_preserved"]
+    )
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -321,19 +479,37 @@ def main(argv: list[str] | None = None) -> int:
         dtypes=("float32",) if ns.smoke else zero.COMMS_DTYPES,
     )
 
+    # Bucket x dtype combos; each one gets overlap on AND off legs so
+    # the exposed-collective-time delta is a pair of rows, not a claim.
+    # Smoke uses the small bucket (several buckets on this workload —
+    # the overlap pipeline actually has stages to hide).
     if ns.smoke:
-        zero1_cfgs = [zero.Zero1Config()]
+        combos = [(65536, "float32")]
     else:
-        zero1_cfgs = [
-            zero.Zero1Config(bucket_bytes=bb, comms_dtype=dt)
+        combos = [
+            (bb, dt)
             for bb in (65536, zero.DEFAULT_BUCKET_BYTES)
             for dt in zero.COMMS_DTYPES
         ]
     sweep = [bench_point(mesh, "replicated", ns.steps)]
-    for cfg in zero1_cfgs:
-        sweep.append(bench_point(mesh, "zero1", ns.steps, cfg))
-        bench_collectives(mesh, cfg, ns.reps)
+    for bb, dt in combos:
+        coll = bench_collectives(
+            mesh, zero.Zero1Config(bucket_bytes=bb, comms_dtype=dt), ns.reps
+        )
+        standalone_ms = coll["reduce_scatter_ms"] + coll["allgather_ms"]
+        for ov in (True, False):
+            cfg = zero.Zero1Config(
+                bucket_bytes=bb, comms_dtype=dt, overlap=ov
+            )
+            point = bench_point(mesh, "zero1", ns.steps, cfg)
+            exposed_frac = 1.0 - point["hidden_fraction"]
+            point["collective_ms_standalone"] = round(standalone_ms, 3)
+            point["exposed_collective_ms_est"] = round(
+                standalone_ms * exposed_frac, 3
+            )
+            sweep.append(point)
     artifact["sweep"] = sweep
+    artifact["hybrid"] = bench_hybrid(ns.steps)
 
     # Fold this process's comms.* spans into the same rollup shape the
     # gang report uses (telemetry_report.py "Comms" section).
@@ -345,6 +521,7 @@ def main(argv: list[str] | None = None) -> int:
 
     artifact["ok"] = bool(
         artifact["equivalence"]["ok"]
+        and artifact["hybrid"]["ok"]
         and all("steps_per_sec" in p for p in sweep)
     )
     _write(artifact, ns.out)

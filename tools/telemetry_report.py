@@ -4,8 +4,10 @@ The offline half of ``telemetry.aggregate``: point it at a directory of
 ``telemetry_rank<k>.jsonl`` files (a gang workdir, or wherever
 ``MLSPARK_TELEMETRY_DIR`` pointed) and get the gang-wide per-phase
 p50/p99 table, the rank-skew (straggler attribution) report, a comms
-section (zero1 wire bytes per step, collective span p50/p99) when the
-run recorded any ``comms.*`` events, an ingest section (``data.*``
+section (zero1 wire bytes per step, overlapped-vs-exposed byte split,
+collective span p50/p99, and a comms-bound vs compute-bound verdict —
+the comms twin of the ingest input-bound verdict) when the run recorded
+any ``comms.*`` events, an ingest section (``data.*``
 stage durations, prefetch-buffer occupancy, input-bound vs compute-bound
 verdict) when it recorded any ``data.*`` events, and serving + per-request
 latency-breakdown sections (queue wait / ttft / service / total stats,
