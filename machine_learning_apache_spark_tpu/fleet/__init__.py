@@ -1,0 +1,83 @@
+"""fleet/ — multi-replica serving data plane: N engines, one front door.
+
+The serving engine (``serving/``) is a single process; the launcher
+(``launcher/``) can spawn and supervise N of them; the observability
+plane (``telemetry/http``) makes each one scrapeable. This package is
+the layer that turns those N replicas into one service:
+
+- :mod:`~.scrape` — the scrape data plane (promoted from
+  ``tools/gang_status.py``): per-replica ``/healthz`` + ``/statusz``
+  snapshots with retry/backoff, and a background :class:`~.scrape.ScrapeLoop`
+  that follows replicas across restarts via their sidecar files;
+- :mod:`~.affinity` — prefix-cache affinity: ``prefix_digest`` →
+  candidate replicas, fed by routing memory and scraped residency;
+- :mod:`~.admission` — SLO tiers (interactive vs batch deadlines) and
+  per-tenant quotas on the ``Backpressure``/retry-after contract;
+- :mod:`~.router` — health-aware dispatch (affinity-first, least-loaded
+  fallback, round-robin baseline) that drains around 503s and keeps a
+  conservation ledger over every routed request;
+- :mod:`~.replica` — the per-rank data plane: ``POST /v1/generate``
+  over one engine plus the delegated observability GET endpoints, and
+  ``serve_replica`` as the launcher-gang worker body.
+
+Replica gangs with *per-rank* restart (vs the Distributor's
+all-or-nothing barrier semantics) live in
+``launcher.replica_gang.ReplicaGang``. Env contract: ``MLSPARK_FLEET_*``
+(see docs/FLEET.md).
+"""
+
+from machine_learning_apache_spark_tpu.fleet.admission import (
+    FleetAdmission,
+    FleetBackpressure,
+    Lease,
+    SLOTier,
+    default_tiers,
+)
+from machine_learning_apache_spark_tpu.fleet.affinity import (
+    AffinityTable,
+    prefix_digest,
+)
+from machine_learning_apache_spark_tpu.fleet.replica import (
+    ReplicaServer,
+    serve_replica,
+    write_fleet_sidecar,
+)
+from machine_learning_apache_spark_tpu.fleet.router import (
+    POLICIES,
+    FleetRequestFailed,
+    FleetRouter,
+    FleetUnavailable,
+    ReplicaClient,
+    pick_replica,
+)
+from machine_learning_apache_spark_tpu.fleet.scrape import (
+    ReplicaSnapshot,
+    ScrapeLoop,
+    find_fleet_sidecars,
+    scrape,
+    snapshot_replica,
+)
+
+__all__ = [
+    "AffinityTable",
+    "FleetAdmission",
+    "FleetBackpressure",
+    "FleetRequestFailed",
+    "FleetRouter",
+    "FleetUnavailable",
+    "Lease",
+    "POLICIES",
+    "ReplicaClient",
+    "ReplicaServer",
+    "ReplicaSnapshot",
+    "SLOTier",
+    "ScrapeLoop",
+    "default_tiers",
+    "find_fleet_sidecars",
+    "pick_replica",
+    "prefix_digest",
+    "scrape",
+    "serve_replica",
+    "snapshot_replica",
+    "write_fleet_sidecar",
+]

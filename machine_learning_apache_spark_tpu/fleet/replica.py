@@ -1,0 +1,361 @@
+"""Replica data plane — one serving engine behind one HTTP front door.
+
+Each fleet rank runs a :class:`ReplicaServer` around its
+``ServingEngine``: ``POST /v1/generate`` maps the engine's request
+contract onto HTTP status codes the router can dispatch around —
+
+- **200** — translation complete; body carries text, trace id, token
+  count.
+- **429** — the replica queue pushed back (``Backpressure``); body and
+  ``Retry-After`` header carry the queue's own estimate. The router may
+  try another replica.
+- **503** — the engine is degraded (mid-quarantine) or stopping; the
+  router must *drain* around this replica until ``/healthz`` recovers.
+- **504** — the request's deadline expired inside this replica.
+- **500** — the decode step itself failed (``InternalError``).
+
+The same server answers the observability plane's GET endpoints
+(``/healthz``, ``/statusz``, ``/metrics``, ``/flightz``) by delegating
+to ``telemetry.http``'s payload functions, so the router's scrape loop
+judges the *data-plane* socket — a replica whose server wedged can't
+look healthy through a separate port.
+
+Discovery follows the telemetry sidecar idiom: the bound port lands in
+``fleet_rank<k>.json`` (``MLSPARK_FLEET_DIR``, defaulting to the
+telemetry dir). :func:`serve_replica` is the launcher-gang worker body:
+build engine, serve, poll for the ``fleet_stop`` marker, drain, report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from machine_learning_apache_spark_tpu.serving.queue import (
+    Backpressure,
+    DeadlineExceeded,
+)
+from machine_learning_apache_spark_tpu.telemetry import events as _events
+from machine_learning_apache_spark_tpu.telemetry import http as _thttp
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: Router-visible generate timeout padding beyond the request deadline.
+RESULT_GRACE_S = 10.0
+STOP_MARKER = "fleet_stop"
+
+
+def fleet_sidecar_name(rank: int) -> str:
+    return f"fleet_rank{rank}.json"
+
+
+def write_fleet_sidecar(
+    port: int, directory: str | None = None, rank: int | None = None
+) -> str | None:
+    """Publish the data-plane port for the router's discovery — same
+    atomic tmp+replace discipline as ``telemetry.http.write_port_sidecar``."""
+    d = directory or fleet_dir()
+    if not d:
+        return None
+    if rank is None:
+        r = _events._env_rank()
+        rank = 0 if r is None else r
+    path = os.path.join(d, fleet_sidecar_name(rank))
+    payload = {
+        "port": port,
+        "rank": rank,
+        "pid": os.getpid(),
+        "wall": round(time.time(), 3),
+    }
+    try:
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def fleet_dir() -> str | None:
+    """Where fleet sidecars and the stop marker live:
+    ``MLSPARK_FLEET_DIR`` > telemetry dir."""
+    return os.environ.get("MLSPARK_FLEET_DIR") or _events.telemetry_dir()
+
+
+class _ReplicaHandler(BaseHTTPRequestHandler):
+    server_version = "mlspark-fleet-replica"
+
+    def log_message(self, *args) -> None:  # noqa: ARG002 — not log spam
+        pass
+
+    # -- data plane ----------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802 — http.server API
+        if self.path != "/v1/generate":
+            self._reply(404, {"error": f"no endpoint {self.path!r}"})
+            return
+        owner: ReplicaServer = self.server.replica  # type: ignore[attr-defined]
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length).decode("utf-8"))
+            text = body["text"]
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": f"bad request body: {e!r}"})
+            return
+        code, payload = owner.generate(
+            text,
+            deadline_s=body.get("deadline_s"),
+            tier=body.get("tier"),
+            tenant=body.get("tenant"),
+        )
+        headers = {}
+        if code == 429 and payload.get("retry_after") is not None:
+            headers["Retry-After"] = f"{payload['retry_after']:.3f}"
+        self._reply(code, payload, headers)
+
+    # -- observability plane (delegated) -------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        try:
+            if self.path.startswith("/metrics"):
+                self._reply_raw(
+                    200, _thttp.metrics_text(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif self.path.startswith("/healthz"):
+                payload, healthy = _thttp.healthz()
+                self._reply(200 if healthy else 503, payload)
+            elif self.path.startswith("/flightz"):
+                self._reply(200, _thttp.flightz())
+            elif self.path.startswith("/statusz") or self.path == "/":
+                self._reply(200, _thttp.statusz())
+            else:
+                self._reply(404, {"error": f"no endpoint {self.path!r}"})
+        except Exception as e:  # noqa: BLE001 — a scrape must not kill the thread
+            self._reply(500, {"error": repr(e)})
+
+    # -- plumbing ------------------------------------------------------------
+    def _reply(
+        self, code: int, payload: dict, headers: dict | None = None
+    ) -> None:
+        self._reply_raw(
+            code, json.dumps(payload) + "\n", "application/json", headers
+        )
+
+    def _reply_raw(
+        self,
+        code: int,
+        body: str,
+        ctype: str,
+        headers: dict | None = None,
+    ) -> None:
+        data = body.encode("utf-8")
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up — its in-flight request, its loss
+
+
+class ReplicaServer:
+    """The HTTP front door over one started ``ServingEngine``."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        rank: int | None = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        health_fn=None,
+    ):
+        self.engine = engine
+        r = _events._env_rank()
+        self.rank = rank if rank is not None else (0 if r is None else r)
+        # Injectable health for tests; production uses the engine's own
+        # /healthz verdict (worker alive + quarantine recovered).
+        self._health_fn = health_fn or (
+            lambda: engine._health_snapshot().get("healthy", False)
+        )
+        self._httpd = ThreadingHTTPServer((host, port), _ReplicaHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.replica = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+        self.sidecar_path: str | None = None
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.completed = 0
+        self.rejected = 0
+        self.refused_503 = 0
+        self.failed = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, *, directory: str | None = None) -> "ReplicaServer":
+        if self._thread is not None:
+            raise RuntimeError("replica server already started")
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            name=f"fleet-replica-{self.rank}",
+            daemon=True,
+        )
+        self._thread.start()
+        self.sidecar_path = write_fleet_sidecar(
+            self.port, directory=directory, rank=self.rank
+        )
+        _events.beacon_update(fleet_port=self.port)
+        _events.annotate("fleet.replica_started", rank=self.rank,
+                         port=self.port)
+        return self
+
+    def stop(self) -> None:
+        t = self._thread
+        if t is None:
+            return
+        self._httpd.shutdown()
+        t.join(10.0)
+        self._httpd.server_close()
+        self._thread = None
+        if self.sidecar_path:
+            try:
+                os.unlink(self.sidecar_path)
+            except OSError:
+                pass
+
+    def __enter__(self) -> "ReplicaServer":
+        if self._thread is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- request path (handler threads call in) ------------------------------
+    def generate(
+        self,
+        text: str,
+        *,
+        deadline_s: float | None = None,
+        tier: str | None = None,
+        tenant: str | None = None,
+    ) -> tuple[int, dict]:
+        with self._lock:
+            self.requests += 1
+        if not self._healthy():
+            # Drain signal: degraded replicas refuse *before* the queue,
+            # so a quarantined engine's backlog drains while new traffic
+            # flows to healthy replicas.
+            with self._lock:
+                self.refused_503 += 1
+            return 503, {
+                "error": "replica degraded",
+                "rank": self.rank,
+            }
+        try:
+            req = self.engine.submit(text, deadline_s=deadline_s)
+        except Backpressure as e:
+            with self._lock:
+                self.rejected += 1
+            return 429, {
+                "error": "backpressure",
+                "retry_after": e.retry_after,
+                "depth": e.depth,
+                "rank": self.rank,
+            }
+        except ValueError as e:
+            with self._lock:
+                self.failed += 1
+            return 400, {"error": str(e), "rank": self.rank}
+        except RuntimeError as e:  # EngineStopped / not started
+            with self._lock:
+                self.refused_503 += 1
+            return 503, {"error": repr(e), "rank": self.rank}
+        timeout = (deadline_s or 120.0) + RESULT_GRACE_S
+        try:
+            out = req.result(timeout=timeout)
+        except DeadlineExceeded as e:
+            with self._lock:
+                self.failed += 1
+            return 504, {"error": str(e), "rank": self.rank,
+                         "trace_id": req.trace.trace_id}
+        except Exception as e:  # noqa: BLE001 — InternalError, stop, timeout
+            with self._lock:
+                self.failed += 1
+            return 500, {"error": repr(e), "rank": self.rank,
+                         "trace_id": req.trace.trace_id}
+        with self._lock:
+            self.completed += 1
+        return 200, {
+            "text": out,
+            "rank": self.rank,
+            "trace_id": req.trace.trace_id,
+            "tier": tier,
+            "tenant": tenant,
+            "tokens": len(self.engine.translator.trg_pipe.ragged([out])[0]),
+        }
+
+    def _healthy(self) -> bool:
+        try:
+            return bool(self._health_fn())
+        except Exception:
+            return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rank": self.rank,
+                "port": self.port,
+                "requests": self.requests,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "refused_503": self.refused_503,
+                "failed": self.failed,
+            }
+
+
+def serve_replica(
+    translator,
+    engine_knobs: dict | None = None,
+    *,
+    rank: int | None = None,
+    directory: str | None = None,
+    port: int | None = None,
+    max_s: float = 3600.0,
+    poll_s: float = 0.1,
+) -> dict:
+    """Gang-worker body: start engine + data plane, publish the sidecar,
+    serve until the driver drops a ``fleet_stop`` marker in the fleet
+    dir (or ``max_s`` passes), then drain and report. Importable by
+    reference — the replica-gang launch mode runs exactly this."""
+    d = directory or fleet_dir() or "."
+    if port is None:
+        port = int(os.environ.get("MLSPARK_FLEET_PORT", "0"))
+    knobs = dict(engine_knobs or {})
+    engine = translator.serve(start=False, **knobs)
+    stop_marker = os.path.join(d, STOP_MARKER)
+    with engine:
+        server = ReplicaServer(engine, rank=rank, port=port)
+        server.start(directory=d)
+        try:
+            _events.beacon_update(phase="serving")
+            deadline = time.monotonic() + max_s
+            while time.monotonic() < deadline:
+                if os.path.exists(stop_marker):
+                    break
+                time.sleep(poll_s)
+            stats = server.stats()
+        finally:
+            server.stop()
+        ledger = engine.metrics.ledger()
+    return {"server": stats, "ledger": ledger}

@@ -1,0 +1,466 @@
+"""Fleet router — health-aware, affinity-first dispatch over N replicas.
+
+The decision core, :func:`pick_replica`, is a pure function over
+``{rank: ReplicaSnapshot}`` so every policy is unit-testable on
+synthetic snapshots, no sockets involved:
+
+- ``round_robin`` — cycle the healthy set (the baseline the affinity
+  gate in ``tools/fleet_bench.py`` measures against);
+- ``least_loaded`` — min in-flight over healthy replicas;
+- ``affinity`` (default) — prefer healthy replicas the
+  :class:`~machine_learning_apache_spark_tpu.fleet.affinity.AffinityTable`
+  says already hold the prompt's prefix (least-loaded among them),
+  falling back to least-loaded overall.
+
+:class:`FleetRouter` wraps the decision in the full dispatch loop:
+admission (SLO tiers + tenant quotas) → pick → POST → and *drain-around*
+on refusals. The retry taxonomy is the whole fault story:
+
+- **connection refused / 503** — the request never entered that
+  replica's queue; safe to retry on the next-best replica, and the
+  refusing rank goes into a penalty box until a scrape sees ``/healthz``
+  recover.
+- **429** — the replica queue pushed back; try the others, and if every
+  replica pushes back, surface one ``FleetBackpressure`` with the max
+  retry-after (the fleet really is full).
+- **connection lost mid-request / 5xx** — the request may have been
+  decoding; it is *not* silently retried (that is the "only the killed
+  replica's in-flight is lost" conservation story) and counts failed.
+
+Every terminal outcome lands in the router ledger, which obeys the same
+conservation law as the engine's: submitted == completed + rejected +
+unavailable + failed. ``check_conservation`` raises otherwise.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from machine_learning_apache_spark_tpu.fleet.admission import (
+    FleetAdmission,
+    FleetBackpressure,
+)
+from machine_learning_apache_spark_tpu.fleet.affinity import AffinityTable
+from machine_learning_apache_spark_tpu.fleet.scrape import (
+    ReplicaSnapshot,
+    ScrapeLoop,
+)
+from machine_learning_apache_spark_tpu.telemetry import events as _events
+from machine_learning_apache_spark_tpu.telemetry import (
+    registry as _registry,
+)
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+POLICIES = ("affinity", "least_loaded", "round_robin")
+
+#: Affinity is load-bounded: a warm (prefix-resident) replica is
+#: preferred only while its scraped load is within this many requests of
+#: the least-loaded healthy replica. Unbounded affinity pins traffic:
+#: after a failover every digest's routing memory points at the
+#: survivor, and a restarted replica would never see a request again —
+#: cache residency must lose to a big enough load gap.
+AFFINITY_LOAD_SLACK = 2.0
+
+
+class FleetUnavailable(RuntimeError):
+    """No healthy replica could take the request."""
+
+
+class FleetRequestFailed(RuntimeError):
+    """The request was dispatched and lost (replica died mid-decode) or
+    the decode itself failed — not retried, by design."""
+
+    def __init__(self, msg: str, *, rank: int | None = None,
+                 status: int | None = None):
+        super().__init__(msg)
+        self.rank = rank
+        self.status = status
+
+
+def pick_replica(
+    snapshots: dict[int, ReplicaSnapshot],
+    *,
+    policy: str = "affinity",
+    candidates: set[int] | None = None,
+    exclude: set[int] | None = None,
+    rr_state: itertools.count | None = None,
+) -> int | None:
+    """The dispatch decision, pure over snapshots. ``candidates`` is the
+    affinity table's claim for this prompt; ``exclude`` is ranks already
+    tried this request. Unhealthy replicas are never picked — that *is*
+    the 503-draining property. Returns a rank or None."""
+    if policy not in POLICIES:
+        raise ValueError(f"unknown policy {policy!r} (pick from {POLICIES})")
+    exclude = exclude or set()
+    healthy = sorted(
+        r for r, s in snapshots.items() if s.healthy and r not in exclude
+    )
+    if not healthy:
+        return None
+    if policy == "round_robin":
+        i = next(rr_state) if rr_state is not None else 0
+        return healthy[i % len(healthy)]
+    coldest = min(healthy, key=lambda r: (snapshots[r].load, r))
+    if policy == "affinity" and candidates:
+        warm = [r for r in healthy if r in candidates]
+        if warm:
+            best = min(warm, key=lambda r: (snapshots[r].load, r))
+            if snapshots[best].load <= (
+                snapshots[coldest].load + AFFINITY_LOAD_SLACK
+            ):
+                return best
+    return coldest
+
+
+class ReplicaClient:
+    """Blocking HTTP client for one dispatch attempt. Separates
+    connection-establishment failures (safe to retry elsewhere) from
+    mid-request losses (not safe — the work may be half done)."""
+
+    @staticmethod
+    def generate(
+        port: int,
+        text: str,
+        *,
+        deadline_s: float | None,
+        tier: str,
+        tenant: str | None,
+        timeout: float,
+    ) -> tuple[str, int | None, dict]:
+        """Returns ``(kind, http_status, payload)`` with kind in
+        {"ok", "refused", "backpressure", "failed", "lost"}."""
+        body = json.dumps({
+            "text": text,
+            "deadline_s": deadline_s,
+            "tier": tier,
+            "tenant": tenant,
+        }).encode("utf-8")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return "ok", resp.status, json.loads(
+                    resp.read().decode("utf-8")
+                )
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode("utf-8"))
+            except Exception:
+                payload = {}
+            if e.code == 429:
+                return "backpressure", 429, payload
+            if e.code == 503:
+                return "refused", 503, payload
+            # 400/500/504: the replica answered — the request itself is
+            # terminal there; retrying would double-spend decode work.
+            return "failed", e.code, payload
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None), ConnectionRefusedError):
+                # Never reached a socket: replica dead or restarting.
+                return "refused", None, {"error": repr(e)}
+            return "lost", None, {"error": repr(e)}
+        except Exception as e:  # noqa: BLE001 — socket reset mid-read etc.
+            return "lost", None, {"error": repr(e)}
+
+
+class FleetRouter:
+    """N replicas, one front door.
+
+    ``key_fn(text) -> digest`` supplies the prefix-affinity key (wire it
+    to ``serving.prefix_digest`` over the same tokenizer the replicas
+    run — see ``tools/fleet_bench.py``); None disables affinity for that
+    request. ``snapshot_source`` defaults to a background
+    :class:`ScrapeLoop` over ``directory`` but tests inject a plain
+    callable returning synthetic snapshots."""
+
+    def __init__(
+        self,
+        directory: str | None = None,
+        *,
+        policy: str | None = None,
+        key_fn=None,
+        admission: FleetAdmission | None = None,
+        affinity: AffinityTable | None = None,
+        snapshot_source=None,
+        scrape_interval: float | None = None,
+        request_timeout_s: float = 120.0,
+        clock=time.monotonic,
+    ):
+        import os
+
+        if policy is None:
+            policy = os.environ.get("MLSPARK_FLEET_POLICY", "affinity")
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r} (pick from {POLICIES}; check "
+                "MLSPARK_FLEET_POLICY)"
+            )
+        if snapshot_source is None and directory is None:
+            raise ValueError(
+                "pass a sidecar directory (scrape-loop mode) or an "
+                "explicit snapshot_source"
+            )
+        if scrape_interval is None:
+            scrape_interval = float(
+                os.environ.get("MLSPARK_FLEET_SCRAPE_INTERVAL", "0.5")
+            )
+        self.policy = policy
+        self.key_fn = key_fn
+        self.clock = clock
+        self.request_timeout_s = request_timeout_s
+        self.admission = admission or FleetAdmission()
+        self.affinity = affinity or AffinityTable()
+        self._scrape: ScrapeLoop | None = None
+        if snapshot_source is None:
+            self._scrape = ScrapeLoop(
+                directory,
+                interval=scrape_interval,
+                on_snapshot=self._on_scrape,
+            )
+            snapshot_source = self._scrape.snapshots
+        self._snapshot_source = snapshot_source
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+        # Penalty box: rank -> monotonic time of last refusal. A boxed
+        # rank is skipped until a scrape reports it healthy again (the
+        # scrape loop is the source of recovery truth).
+        self._down: dict[int, float] = {}
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0      # fleet admission / all-replica backpressure
+        self.unavailable = 0   # no healthy replica reachable
+        self.failed = 0        # dispatched and lost / decode failure
+        self.retries = 0
+        self._per_replica: dict[int, dict] = {}
+        self._reg = _registry.get_registry()
+        self._counters = {
+            name: self._reg.counter("fleet", name)
+            for name in ("submitted", "completed", "rejected",
+                         "unavailable", "failed", "retries")
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        if self._scrape is not None:
+            self._scrape.start()
+        return self
+
+    def stop(self) -> None:
+        if self._scrape is not None:
+            self._scrape.stop()
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def wait_for_replicas(self, n: int, timeout: float = 120.0) -> bool:
+        if self._scrape is None:
+            return len([
+                s for s in self._snapshot_source().values() if s.healthy
+            ]) >= n
+        return self._scrape.wait_for_replicas(n, timeout=timeout)
+
+    # -- scrape feedback -----------------------------------------------------
+    def _on_scrape(self, snapshots: dict[int, ReplicaSnapshot]) -> None:
+        """Scrape tick: refresh affinity residency and let recovered
+        replicas out of the penalty box."""
+        with self._lock:
+            for rank, snap in snapshots.items():
+                if snap.healthy:
+                    self._down.pop(rank, None)
+            gone = [r for r in self._down if r not in snapshots]
+            for r in gone:
+                self._down.pop(r, None)
+        for rank, snap in snapshots.items():
+            if snap.healthy:
+                self.affinity.observe_scrape(rank, snap.prefix_digests)
+            else:
+                self.affinity.forget_rank(rank)
+
+    def _usable_snapshots(self) -> dict[int, ReplicaSnapshot]:
+        snaps = self._snapshot_source()
+        with self._lock:
+            down = set(self._down)
+        return {r: s for r, s in snaps.items() if r not in down}
+
+    def _box(self, rank: int) -> None:
+        with self._lock:
+            self._down[rank] = self.clock()
+
+    # -- the dispatch loop ---------------------------------------------------
+    def submit(
+        self,
+        text: str,
+        *,
+        tier: str = "interactive",
+        tenant: str | None = None,
+        deadline_s: float | None = None,
+    ) -> dict:
+        """Route one request to completion. Returns the replica's 200
+        payload. Raises :class:`FleetBackpressure` (whole fleet at
+        capacity / quota exhausted), :class:`FleetUnavailable` (no
+        healthy replica), :class:`FleetRequestFailed` (dispatched and
+        lost or decode-failed — the non-retried taxonomy)."""
+        t0 = self.clock()
+        self._bump("submitted")
+        try:
+            lease = self.admission.admit(tier=tier, tenant=tenant)
+        except FleetBackpressure:
+            self._bump("rejected")
+            raise
+        digest = None
+        retries = 0
+        outcome, out_rank, status = "failed", None, None
+        try:
+            if self.key_fn is not None:
+                try:
+                    digest = self.key_fn(text)
+                except Exception:
+                    digest = None
+            deadline = deadline_s if deadline_s is not None else lease.deadline_s
+            tried: set[int] = set()
+            backpressure: FleetBackpressure | None = None
+            while True:
+                snaps = self._usable_snapshots()
+                rank = pick_replica(
+                    snaps,
+                    policy=self.policy,
+                    candidates=self.affinity.candidates(digest),
+                    exclude=tried,
+                    rr_state=self._rr,
+                )
+                if rank is None:
+                    if backpressure is not None:
+                        outcome = "rejected"
+                        self._bump("rejected")
+                        raise backpressure
+                    outcome = "unavailable"
+                    self._bump("unavailable")
+                    raise FleetUnavailable(
+                        f"no healthy replica (tried {sorted(tried)})"
+                    )
+                tried.add(rank)
+                snap = snaps[rank]
+                self._note(rank, "dispatched")
+                kind, status, payload = ReplicaClient.generate(
+                    snap.port, text,
+                    deadline_s=deadline, tier=tier, tenant=tenant,
+                    timeout=min(self.request_timeout_s,
+                                deadline + 30.0),
+                )
+                if kind == "ok":
+                    self.affinity.note_routed(digest, rank)
+                    self._note(rank, "completed")
+                    outcome, out_rank = "completed", rank
+                    self._bump("completed")
+                    return payload
+                if kind == "refused":
+                    # 503 / connection refused: never entered the queue.
+                    # Box the rank (scrape recovery lets it back) and
+                    # drain to the next-best replica.
+                    self._box(rank)
+                    self.affinity.forget_rank(rank)
+                    self._note(rank, "refused")
+                    retries += 1
+                    self._bump("retries")
+                    continue
+                if kind == "backpressure":
+                    self._note(rank, "backpressure")
+                    ra = (payload or {}).get("retry_after") or 0.05
+                    if backpressure is None or ra > backpressure.retry_after:
+                        backpressure = FleetBackpressure(
+                            (payload or {}).get("depth", 0), ra,
+                            scope=f"replica:{rank}",
+                        )
+                    retries += 1
+                    self._bump("retries")
+                    continue
+                # "lost" or "failed": terminal, not retried.
+                self._note(rank, "lost" if kind == "lost" else "failed")
+                outcome, out_rank = kind, rank
+                self._bump("failed")
+                if kind == "lost":
+                    # The socket died under a dispatched request — treat
+                    # the rank as down for new traffic too.
+                    self._box(rank)
+                raise FleetRequestFailed(
+                    f"request {kind} on replica {rank} "
+                    f"(status={status}): {(payload or {}).get('error')}",
+                    rank=rank, status=status,
+                )
+        finally:
+            total = self.clock() - t0
+            self.admission.release(lease, service_s=total)
+            _events.annotate(
+                "fleet.request",
+                outcome=outcome, replica=out_rank, tier=tier,
+                tenant=tenant, retries=retries, total_s=round(total, 6),
+                status=status,
+            )
+
+    # -- accounting ----------------------------------------------------------
+    def _bump(self, name: str) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + 1)
+        self._counters[name].inc()
+
+    def _note(self, rank: int, event: str) -> None:
+        with self._lock:
+            row = self._per_replica.setdefault(rank, {
+                "dispatched": 0, "completed": 0, "refused": 0,
+                "backpressure": 0, "failed": 0, "lost": 0,
+            })
+            row[event] = row.get(event, 0) + 1
+
+    def ledger(self) -> dict:
+        with self._lock:
+            out = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "unavailable": self.unavailable,
+                "failed": self.failed,
+            }
+        out["in_flight"] = (
+            out["submitted"] - out["completed"] - out["rejected"]
+            - out["unavailable"] - out["failed"]
+        )
+        return out
+
+    def check_conservation(self, *, in_flight: int = 0) -> dict:
+        """Router-side conservation law — every submitted request is
+        accounted for in exactly one terminal counter."""
+        ledger = self.ledger()
+        if ledger["in_flight"] != in_flight:
+            raise AssertionError(
+                f"fleet conservation violated: expected in_flight="
+                f"{in_flight}, ledger says {ledger}"
+            )
+        return ledger
+
+    def stats(self) -> dict:
+        with self._lock:
+            per_replica = {r: dict(v) for r, v in self._per_replica.items()}
+            down = sorted(self._down)
+        return {
+            "policy": self.policy,
+            "ledger": self.ledger(),
+            "retries": self.retries,
+            "per_replica": per_replica,
+            "down": down,
+            "admission": self.admission.stats(),
+            "affinity": self.affinity.stats(),
+        }

@@ -16,6 +16,9 @@ from machine_learning_apache_spark_tpu.launcher.monitor import (
     GangMonitor,
     terminate_gang,
 )
+from machine_learning_apache_spark_tpu.launcher.replica_gang import (
+    ReplicaGang,
+)
 
 __all__ = [
     "RendezvousSpec",
@@ -26,6 +29,7 @@ __all__ = [
     "fn_reference",
     "GangFailure",
     "GangMonitor",
+    "ReplicaGang",
     "kill_stray_gangs",
     "terminate_gang",
 ]

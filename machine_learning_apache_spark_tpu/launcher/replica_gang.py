@@ -1,0 +1,305 @@
+"""Replica-gang launch mode — N independent workers, per-rank restart.
+
+The ``Distributor`` implements Spark-barrier semantics on purpose: one
+dead rank fails the gang, the gang retries whole. That is right for
+training (a collective missing one participant deadlocks) and exactly
+wrong for a serving fleet, where the whole point of running N replicas
+is that losing one costs one replica's in-flight work and *nothing
+else*. ``ReplicaGang`` is the launcher's second launch mode for that
+shape:
+
+- Each rank is a standalone ``launcher.runner`` subprocess (same entry
+  point, same heartbeat/telemetry/platform plumbing) with **no
+  rendezvous env** — ``initialize_from_env`` no-ops, so replicas never
+  form a collective and one dying cannot wedge the rest.
+- A supervisor thread watches exits and heartbeat staleness **per
+  rank** and restarts only the dead rank, with exponential backoff and
+  a per-rank restart budget. A restarted replica re-binds an ephemeral
+  port and overwrites its sidecars; discovery (``fleet/scrape.py``)
+  follows it there.
+- ``kill_rank`` is the fault-drill hook: SIGKILL one replica's process
+  group and let supervision prove the recovery story.
+
+Process-group hygiene matches the Distributor: every worker is a
+session leader, registered in the module-level stray-gang registry so
+the atexit/conftest sweeps reap leftovers from a crashed driver.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any
+
+from machine_learning_apache_spark_tpu.launcher.distributor import (
+    _register_gang,
+    _unregister_gang,
+    fn_reference,
+)
+from machine_learning_apache_spark_tpu.launcher.monitor import (
+    _signal_proc,
+    terminate_gang,
+)
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: Env vars that would make a replica try to rendezvous — scrubbed from
+#: every spawn (replicas are world-size-1 by construction).
+_RENDEZVOUS_ENV = (
+    "MLSPARK_COORDINATOR", "MASTER_ADDR", "MASTER_PORT",
+    "WORLD_SIZE", "RANK", "MLSPARK_NUM_PROCESSES",
+)
+
+
+class ReplicaGang:
+    """Spawn and supervise ``num_replicas`` independent serving workers.
+
+    ``fn`` is run by importable reference in every rank (the
+    ``fleet.replica.serve_replica`` wrapper, usually). The gang does not
+    block: ``start()`` returns once every rank is spawned; the replicas
+    announce themselves through their own sidecars. ``stop()`` drops the
+    ``fleet_stop`` marker for a clean drain, then escalates.
+    """
+
+    def __init__(
+        self,
+        fn,
+        *args: Any,
+        num_replicas: int = 2,
+        workdir: str | None = None,
+        platform: str | None = None,
+        env: dict[str, str] | None = None,
+        telemetry_http: int | None = 0,
+        heartbeat_interval: float = 0.5,
+        heartbeat_timeout: float | None = None,
+        max_restarts_per_rank: int = 2,
+        backoff_base: float = 0.5,
+        backoff_max: float = 10.0,
+        term_grace: float = 5.0,
+        **kwargs: Any,
+    ):
+        if num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {num_replicas}"
+            )
+        self.ref = fn_reference(fn)
+        self.call_args = (args, kwargs)
+        self.num_replicas = num_replicas
+        self.workdir = workdir or tempfile.mkdtemp(prefix="mlspark_fleet_")
+        self.platform = platform
+        self.extra_env = env or {}
+        self.telemetry_http = telemetry_http
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_timeout = heartbeat_timeout
+        self.max_restarts_per_rank = max_restarts_per_rank
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.term_grace = term_grace
+        self._lock = threading.Lock()
+        self._procs: dict[int, subprocess.Popen] = {}
+        self._restart_at: dict[int, float] = {}  # rank -> not-before time
+        self.restarts: dict[int, int] = {r: 0 for r in range(num_replicas)}
+        self.exhausted: set[int] = set()
+        self._stop = threading.Event()
+        self._supervisor: threading.Thread | None = None
+        os.makedirs(self.workdir, exist_ok=True)
+        self._args_path = os.path.join(self.workdir, "fleet_args.pkl")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ReplicaGang":
+        if self._supervisor is not None:
+            raise RuntimeError("replica gang already started")
+        import pickle
+
+        with open(self._args_path, "wb") as f:
+            pickle.dump(self.call_args, f)
+        stop_marker = os.path.join(self.workdir, "fleet_stop")
+        if os.path.exists(stop_marker):
+            os.unlink(stop_marker)  # stale marker from a previous gang
+        self._stop.clear()
+        for rank in range(self.num_replicas):
+            self._spawn(rank)
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="replica-gang-supervisor",
+            daemon=True,
+        )
+        self._supervisor.start()
+        log.info(
+            "replica gang up: %d rank(s) in %s",
+            self.num_replicas, self.workdir,
+        )
+        return self
+
+    def stop(self, *, drain_s: float = 15.0) -> None:
+        """Graceful drain: drop the stop marker, give replicas
+        ``drain_s`` to exit on their own, then SIGTERM→SIGKILL."""
+        self._stop.set()
+        try:
+            with open(os.path.join(self.workdir, "fleet_stop"), "w") as f:
+                f.write("stop\n")
+        except OSError:
+            pass
+        t = self._supervisor
+        if t is not None:
+            t.join(5.0)
+        self._supervisor = None
+        with self._lock:
+            procs = list(self._procs.values())
+        deadline = time.monotonic() + drain_s
+        for p in procs:
+            remaining = max(0.05, deadline - time.monotonic())
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                pass
+        terminate_gang(procs, grace=self.term_grace)
+        _unregister_gang(procs)
+        with self._lock:
+            self._procs.clear()
+
+    def __enter__(self) -> "ReplicaGang":
+        if self._supervisor is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- spawn/supervise -----------------------------------------------------
+    def _spawn(self, rank: int) -> None:
+        heartbeat_path = os.path.join(self.workdir, f"heartbeat_{rank}")
+        env = dict(os.environ)
+        for name in _RENDEZVOUS_ENV:
+            env.pop(name, None)
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" in flags:
+            # Same scrub as the Distributor: a replica is one device.
+            kept = " ".join(
+                f for f in flags.split()
+                if "xla_force_host_platform_device_count" not in f
+            )
+            if kept:
+                env["XLA_FLAGS"] = kept
+            else:
+                env.pop("XLA_FLAGS", None)
+        env.update(self.extra_env)
+        env.setdefault("MLSPARK_TELEMETRY_DIR", self.workdir)
+        env.setdefault("MLSPARK_FLEET_DIR", self.workdir)
+        env.setdefault("MLSPARK_FLEET_PORT", "0")
+        env["MLSPARK_PROCESS_ID"] = str(rank)
+        env["MLSPARK_GANG_ATTEMPT"] = str(self.restarts[rank])
+        env["MLSPARK_HEARTBEAT_FILE"] = heartbeat_path
+        env["MLSPARK_HEARTBEAT_INTERVAL"] = str(self.heartbeat_interval)
+        if self.telemetry_http is not None:
+            env["MLSPARK_TELEMETRY_HTTP"] = str(self.telemetry_http)
+        if self.platform:
+            env["JAX_PLATFORMS"] = self.platform
+            env["MLSPARK_PLATFORM"] = self.platform
+        env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        cmd = [
+            sys.executable,
+            "-m",
+            "machine_learning_apache_spark_tpu.launcher.runner",
+            "--fn", self.ref,
+            "--args-file", self._args_path,
+            "--result-file",
+            os.path.join(self.workdir, f"fleet_result_{rank}.pkl"),
+        ]
+        proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+        with self._lock:
+            self._procs[rank] = proc
+        _register_gang([proc])
+
+    def _supervise(self) -> None:
+        """Per-rank detection + restart. First failure of rank k costs
+        rank k a restart, nothing else — the anti-barrier."""
+        while not self._stop.is_set():
+            now = time.monotonic()
+            with self._lock:
+                ranks = dict(self._procs)
+            for rank, proc in ranks.items():
+                dead = proc.poll() is not None
+                stalled = (
+                    not dead
+                    and self.heartbeat_timeout is not None
+                    and self._heartbeat_age(rank, now) > self.heartbeat_timeout
+                )
+                if not (dead or stalled):
+                    continue
+                if stalled:
+                    log.warning(
+                        "replica %d stalled (heartbeat silent > %.1fs); "
+                        "killing for restart", rank, self.heartbeat_timeout,
+                    )
+                    _signal_proc(proc, signal.SIGKILL)
+                    proc.wait(timeout=10.0)
+                _unregister_gang([proc])
+                if self.restarts[rank] >= self.max_restarts_per_rank:
+                    if rank not in self.exhausted:
+                        self.exhausted.add(rank)
+                        with self._lock:
+                            self._procs.pop(rank, None)
+                        log.error(
+                            "replica %d exhausted its restart budget "
+                            "(%d); leaving it down",
+                            rank, self.max_restarts_per_rank,
+                        )
+                    continue
+                not_before = self._restart_at.get(rank, 0.0)
+                if now < not_before:
+                    continue
+                self.restarts[rank] += 1
+                delay = min(
+                    self.backoff_max,
+                    self.backoff_base * (2 ** (self.restarts[rank] - 1)),
+                )
+                self._restart_at[rank] = now + delay
+                log.warning(
+                    "replica %d down (exit=%s); restart %d/%d",
+                    rank, proc.returncode, self.restarts[rank],
+                    self.max_restarts_per_rank,
+                )
+                self._spawn(rank)
+            self._stop.wait(0.2)
+
+    def _heartbeat_age(self, rank: int, now: float) -> float:
+        path = os.path.join(self.workdir, f"heartbeat_{rank}")
+        try:
+            return max(0.0, time.time() - os.stat(path).st_mtime)
+        except OSError:
+            # No beat yet: age since spawn is unknowable here; treat as
+            # young — exit detection covers a worker that died pre-beat.
+            return 0.0
+
+    # -- drill hooks / introspection -----------------------------------------
+    def kill_rank(self, rank: int) -> bool:
+        """SIGKILL one replica's process group (the fault-drill lever).
+        Supervision notices and restarts it within a poll interval."""
+        with self._lock:
+            proc = self._procs.get(rank)
+        if proc is None or proc.poll() is not None:
+            return False
+        _signal_proc(proc, signal.SIGKILL)
+        return True
+
+    def alive(self) -> dict[int, bool]:
+        with self._lock:
+            return {
+                rank: proc.poll() is None
+                for rank, proc in sorted(self._procs.items())
+            }
+
+    def status(self) -> dict:
+        return {
+            "num_replicas": self.num_replicas,
+            "alive": self.alive(),
+            "restarts": dict(self.restarts),
+            "exhausted": sorted(self.exhausted),
+            "workdir": self.workdir,
+        }

@@ -33,6 +33,7 @@ from machine_learning_apache_spark_tpu.serving.kv_pages import (
     NULL_PAGE,
     KVPagePool,
     PrefixCache,
+    prefix_digest,
 )
 from machine_learning_apache_spark_tpu.serving.kv_slots import KVSlotPool
 from machine_learning_apache_spark_tpu.serving.metrics import (
@@ -67,4 +68,5 @@ __all__ = [
     "ServingEngine",
     "ServingMetrics",
     "TokenBudgetBatcher",
+    "prefix_digest",
 ]

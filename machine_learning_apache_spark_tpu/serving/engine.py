@@ -259,6 +259,14 @@ class ServingEngine:
         # is set and telemetry is on.
         telemetry.register_status_provider("serving", self._status_snapshot)
         telemetry.register_health_provider("serving", self._health_snapshot)
+        if self.runtime is not None:
+            # First-class residency section for the fleet router's
+            # affinity table: bounded MRU digests of the prompts whose
+            # prefix KV this replica already holds (fleet/affinity.py
+            # scrapes sections.prefix_cache off /statusz).
+            telemetry.register_status_provider(
+                "prefix_cache", self.runtime.prefix_cache.stats
+            )
         telemetry.register_live_gauge(
             "serving", "queue_depth_live", lambda: self.queue.depth
         )
@@ -279,6 +287,7 @@ class ServingEngine:
         if self._worker is None:
             return
         telemetry.unregister_provider("serving")
+        telemetry.unregister_provider("prefix_cache")
         self._stop.set()
         with self.queue.cond:
             self.queue.cond.notify_all()

@@ -27,13 +27,30 @@ same starvation fix ``KVSlotPool.acquire_many`` carries.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import threading
 from collections import OrderedDict, deque
+from typing import Sequence
 
 #: Reserved page id: block-table padding. Never allocated, never freed;
 #: scatter/gather paths may touch it freely.
 NULL_PAGE = 0
+
+
+def prefix_digest(key: Sequence[int]) -> str:
+    """Stable, transport-safe digest of a prefix-cache key (the prompt's
+    token ids). The cache itself keys on ``tuple(ids)``; anything that
+    has to ship residency over the wire — ``PrefixCache.stats()`` on
+    ``/statusz``, the fleet router's affinity table — uses this digest
+    instead, so two processes agree on identity without exchanging the
+    ids themselves. blake2b, not ``hash()``: Python's per-process hash
+    randomization would break exactly the cross-process agreement this
+    exists for. Non-int elements (tests key caches with sentinel
+    strings) stringify as-is — identical bytes to ``int()`` coercion
+    for the production token-id case, numpy scalars included."""
+    raw = ",".join(str(t) for t in key).encode("utf-8")
+    return hashlib.blake2b(raw, digest_size=8).hexdigest()
 
 
 class KVPagePool:
@@ -232,7 +249,11 @@ class PrefixCache:
                 return False
         self.pool.add_ref(pages, self.owner_for(key))
         with self._lock:
-            self._entries[key] = {"pages": list(pages), **meta}
+            # Digest computed once at adoption: stats() is scraped on
+            # every /statusz poll and must not re-hash the whole cache.
+            self._entries[key] = {
+                "pages": list(pages), "digest": prefix_digest(key), **meta
+            }
             self._entries.move_to_end(key)
         while len(self._entries) > self.capacity:
             if not self.evict_one():
@@ -269,11 +290,24 @@ class PrefixCache:
         with self._lock:
             return len(self._entries)
 
-    def stats(self) -> dict:
+    def stats(self, *, max_digests: int = 64) -> dict:
+        """Counters plus a bounded residency digest — the fleet router's
+        affinity source of truth (scraped off ``/statusz``). Digests are
+        MRU-first and capped at ``max_digests`` so a big cache can't
+        bloat every scrape; the count of digests *not* listed rides
+        along so a consumer can tell "bounded view" from "everything"."""
         with self._lock:
+            lookups = self.hits + self.misses
+            digests = [
+                e["digest"] for e in reversed(self._entries.values())
+            ][:max_digests]
             return {
                 "entries": len(self._entries),
+                "capacity": self.capacity,
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "hit_rate": round(self.hits / lookups, 4) if lookups else None,
+                "resident_digests": digests,
+                "digests_truncated": max(0, len(self._entries) - len(digests)),
             }

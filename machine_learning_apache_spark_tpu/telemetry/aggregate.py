@@ -470,9 +470,117 @@ def request_report(events: list[dict]) -> dict:
     }
 
 
+def fleet_report(events: list[dict]) -> dict:
+    """Router-side rollup from the ``fleet.request`` annotations
+    ``FleetRouter.submit`` emits (one per routed request, attrs =
+    outcome / replica / tier / tenant / retries / total_s / status):
+
+    - ``by_outcome`` / ``by_tier`` / ``by_tenant``: request counts —
+      the admission and drain story in numbers;
+    - ``per_replica``: how many requests each replica actually served,
+      with end-to-end latency stats — the routing-skew evidence;
+    - ``retries``: total re-dispatches (refused/backpressured replicas
+      the router routed around);
+    - ``latency``: end-to-end (admission → response) stats across all
+      completed requests.
+
+    Empty dict when no ``fleet.request`` annotations exist — the
+    renderer then omits the section.
+    """
+    outcomes: dict[str, int] = {}
+    tiers: dict[str, int] = {}
+    tenants: dict[str, int] = {}
+    per_replica: dict[int, dict] = {}
+    totals: list[float] = []
+    retries = 0
+    n = 0
+    for ev in events:
+        if ev.get("kind") != "annotation" or ev.get("name") != "fleet.request":
+            continue
+        attrs = ev.get("attrs") or {}
+        n += 1
+        outcome = str(attrs.get("outcome"))
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        tier = attrs.get("tier")
+        if tier is not None:
+            tiers[str(tier)] = tiers.get(str(tier), 0) + 1
+        tenant = attrs.get("tenant")
+        if tenant is not None:
+            tenants[str(tenant)] = tenants.get(str(tenant), 0) + 1
+        retries += int(attrs.get("retries") or 0)
+        total_s = attrs.get("total_s")
+        if total_s is not None:
+            totals.append(float(total_s))
+        replica = attrs.get("replica")
+        if replica is not None:
+            entry = per_replica.setdefault(
+                int(replica), {"requests": 0, "_totals": []}
+            )
+            entry["requests"] += 1
+            if total_s is not None:
+                entry["_totals"].append(float(total_s))
+    if not n:
+        return {}
+    return {
+        "requests": n,
+        "by_outcome": dict(sorted(outcomes.items())),
+        "by_tier": dict(sorted(tiers.items())),
+        "by_tenant": dict(sorted(tenants.items())),
+        "retries": retries,
+        "latency": _stats(totals) if totals else None,
+        "per_replica": {
+            rank: {
+                "requests": entry["requests"],
+                "latency": _stats(entry["_totals"])
+                if entry["_totals"] else None,
+            }
+            for rank, entry in sorted(per_replica.items())
+        },
+    }
+
+
+def replica_skew(rows: list[dict]) -> dict:
+    """Fleet-level load-skew verdict from scrape-plane status rows (the
+    ``ScrapeLoop.rows()`` / ``tools/gang_status.py`` shape): which
+    replica ran hottest/coldest by tokens/sec and how lopsided the split
+    was. ``hottest_share`` is the hottest replica's fraction of fleet
+    throughput — 1/N is a perfectly balanced fleet. Empty dict below two
+    replicas with throughput numbers (skew needs a comparison)."""
+    usable = [
+        r for r in rows
+        if isinstance(r.get("tokens_per_sec"), (int, float))
+    ]
+    if len(usable) < 2:
+        return {}
+    hottest = max(usable, key=lambda r: r["tokens_per_sec"])
+    coldest = min(usable, key=lambda r: r["tokens_per_sec"])
+    fleet_tps = sum(r["tokens_per_sec"] for r in usable)
+    cold_tps = coldest["tokens_per_sec"]
+    return {
+        "replicas": {
+            r["rank"]: {
+                "tokens_per_sec": r.get("tokens_per_sec"),
+                "in_flight": r.get("in_flight"),
+                "queue_depth": r.get("queue_depth"),
+                "occupancy": r.get("occupancy"),
+                "prefix_hit_rate": r.get("prefix_hit_rate"),
+            }
+            for r in sorted(usable, key=lambda r: r["rank"])
+        },
+        "hottest_rank": hottest["rank"],
+        "coldest_rank": coldest["rank"],
+        "skew_ratio": round(hottest["tokens_per_sec"] / cold_tps, 4)
+        if cold_tps > 0 else None,
+        "hottest_share": round(hottest["tokens_per_sec"] / fleet_tps, 4)
+        if fleet_tps > 0 else None,
+        "fleet_tokens_per_sec": round(fleet_tps, 3),
+    }
+
+
 def merge_gang_dir(directory: str) -> dict:
     """One-call report over a gang workdir: find rank files, merge, build
-    the phase table, skew report, and the comms/ingest/serving rollups."""
+    the phase table, skew report, and the comms/ingest/serving/fleet
+    rollups."""
     paths = find_rank_files(directory)
     events = merge_rank_files(paths)
     table = phase_table(events)
@@ -487,6 +595,7 @@ def merge_gang_dir(directory: str) -> dict:
         "ingest": ingest_report(events, table),
         "serving": serving_report(events, table),
         "requests": request_report(events),
+        "fleet": fleet_report(events),
     }
 
 
@@ -687,6 +796,32 @@ def render_markdown(report: dict) -> str:
                     f"| {r.get('launches') if r.get('launches') is not None else '-'} "
                     f"| {r.get('prefill') or '-'} |"
                 )
+    fleet = report.get("fleet") or {}
+    if fleet.get("requests"):
+        lines += ["", "## Fleet (routed requests)", ""]
+        parts = ", ".join(
+            f"{k}: {v}" for k, v in fleet["by_outcome"].items()
+        )
+        lines.append(
+            f"- routed: {fleet['requests']} requests "
+            f"({parts}; {fleet['retries']} retries)"
+        )
+        if fleet.get("by_tier"):
+            tiers = ", ".join(
+                f"{k}: {v}" for k, v in fleet["by_tier"].items()
+            )
+            lines.append(f"- tiers: {tiers}")
+        if fleet.get("per_replica"):
+            lines.append("")
+            lines.append("| replica | requests | mean (ms) | p50 | p99 |")
+            lines.append("|---|---|---|---|---|")
+            for rank, entry in fleet["per_replica"].items():
+                s = entry.get("latency") or {}
+                lines.append(
+                    f"| {rank} | {entry['requests']} "
+                    f"| {_fmt(s.get('mean'))} | {_fmt(s.get('p50'))} "
+                    f"| {_fmt(s.get('p99'))} |"
+                )
     return "\n".join(lines) + "\n"
 
 
@@ -732,6 +867,7 @@ __all__ = [
     "REQUEST_REPORT_SLOWEST",
     "comms_report",
     "find_rank_files",
+    "fleet_report",
     "ingest_report",
     "load_jsonl",
     "merge_gang_dir",
@@ -740,6 +876,7 @@ __all__ = [
     "rank_file_name",
     "render_markdown",
     "render_status_markdown",
+    "replica_skew",
     "request_report",
     "serving_report",
     "skew_report",

@@ -14,6 +14,12 @@ against it, and records what the robustness layer did about it:
   and the structured failure must name the rank and cause.
 - ``serving_poison`` — decode batch 0 raises; only its requests may
   fail (``InternalError``), the loop keeps serving, zero recompiles.
+- ``fleet_kill_replica`` (round 3) — a 2-replica serving fleet loses
+  rank 1 to SIGKILL mid-load; only that replica's in-flight requests may
+  be lost (the router's conservation ledger proves no silent loss), the
+  surviving replica keeps serving through the outage, the router drains
+  around the dead rank, the ``ReplicaGang`` supervisor restarts it, and
+  post-recovery traffic reaches it again.
 
 Round 2 additionally asserts the flight recorder: every drilled failure
 must leave a non-empty ``flight_<rank>.json`` (dumped by ``maybe_fault``
@@ -23,7 +29,7 @@ recorded in the artifact.
 
 Usage::
 
-    python tools/fault_drill.py [--out FAULTS_r02.json] [scenario ...]
+    python tools/fault_drill.py [--out FAULTS_r03.json] [scenario ...]
 
 Exits nonzero if any scenario's invariant does not hold, so CI can gate
 on the drill the way it gates on the test suite.
@@ -43,6 +49,7 @@ sys.path.insert(
     0,
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"),
 )
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from machine_learning_apache_spark_tpu.utils import faults  # noqa: E402
 
@@ -268,16 +275,122 @@ def scenario_serving_poison(workdir: str) -> dict:
     }
 
 
+def scenario_fleet_kill_replica(workdir: str) -> dict:
+    """Kill one replica of a 2-replica fleet under closed-loop load.
+
+    The invariant chain: (a) only the killed replica's in-flight
+    requests are lost — bounded by the client concurrency, zero losses
+    on the survivor, and the router ledger conserves every submitted
+    request into exactly one terminal counter; (b) the router drains
+    around the dead rank (the survivor completes requests during the
+    outage, nothing goes fleet-unavailable); (c) the ``ReplicaGang``
+    supervisor restarts the rank on a fresh port, the scrape plane
+    follows it there, and a post-recovery burst lands traffic on it."""
+    import threading
+
+    import fleet_bench
+
+    t0 = time.monotonic()
+    clients = 4
+    translator, texts = fleet_bench.build_translator(tiny=True)
+    knobs = fleet_bench.bench_knobs(tiny=True)
+    fleet_dir = os.path.join(workdir, "fleet")
+    gang, router = fleet_bench.build_fleet(
+        2, fleet_dir, tiny=True, policy="affinity",
+        key_fn=fleet_bench.make_key_fn(translator), knobs=knobs,
+    )
+    try:
+        load_result: dict = {}
+
+        def drive() -> None:
+            load_result.update(fleet_bench.drive_load(
+                router, texts, clients=clients, duration=8.0,
+            ))
+
+        loader = threading.Thread(target=drive, daemon=True)
+        loader.start()
+        time.sleep(2.0)
+        before = router.stats()["per_replica"]
+        killed = gang.kill_rank(1)
+        time.sleep(2.0)
+        during = router.stats()["per_replica"]
+        loader.join(120.0)
+
+        # The drain story: the survivor completed requests while rank 1
+        # was down, and every loss is attributable to rank 1.
+        outage_completed = (
+            during.get(0, {}).get("completed", 0)
+            - before.get(0, {}).get("completed", 0)
+        )
+        per_replica = router.stats()["per_replica"]
+        lost_on_survivor = (
+            per_replica.get(0, {}).get("lost", 0)
+            + per_replica.get(0, {}).get("failed", 0)
+        )
+        lost_total = load_result.get("failed", 0)
+
+        # Supervision: rank 1 must come back (fresh port, fresh sidecar)
+        # and scrape healthy again.
+        recovered = router.wait_for_replicas(2, timeout=180.0)
+        pre_burst = router.stats()["per_replica"]
+        burst = fleet_bench.drive_load(
+            router, texts, clients=clients, duration=3.0,
+        )
+        post_burst = router.stats()["per_replica"]
+        rank1_after_restart = (
+            post_burst.get(1, {}).get("completed", 0)
+            - pre_burst.get(1, {}).get("completed", 0)
+        )
+        conservation = fleet_bench.conservation_gate(router)
+        ledger = conservation["router_ledger"]
+        gang_status = gang.status()
+        router_stats = router.stats()
+    finally:
+        router.stop()
+        gang.stop()
+    return {
+        "scenario": "fleet_kill_replica",
+        "clients": clients,
+        "kill_acknowledged": killed,
+        "load": load_result,
+        "outage_completed_on_survivor": outage_completed,
+        "lost_total": lost_total,
+        "lost_on_survivor": lost_on_survivor,
+        "router_retries": router_stats["retries"],
+        "recovered_healthy": recovered,
+        "recovery_burst": burst,
+        "rank1_completed_after_restart": rank1_after_restart,
+        "conservation": conservation,
+        "gang": gang_status,
+        "per_replica": router_stats["per_replica"],
+        "wall_seconds": round(time.monotonic() - t0, 2),
+        "ok": (
+            killed
+            and gang_status["restarts"].get(1, 0) >= 1
+            and all(gang_status["alive"].values())
+            and outage_completed > 0
+            and lost_on_survivor == 0
+            and lost_total <= clients
+            and load_result.get("unavailable", 0) == 0
+            and recovered
+            and rank1_after_restart > 0
+            and conservation["ok"]
+            and ledger["in_flight"] == 0
+        ),
+    }
+
+
 SCENARIOS = {
     "gang_crash_resume": scenario_gang_crash_resume,
     "gang_stall": scenario_gang_stall,
     "serving_poison": scenario_serving_poison,
+    "fleet_kill_replica": scenario_fleet_kill_replica,
 }
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
-    ap.add_argument("--out", default="FAULTS_r02.json")
+    ap.add_argument("--out", default="FAULTS_r03.json")
     ap.add_argument(
         "scenarios", nargs="*", default=None,
         help=f"subset to run (default: all of {sorted(SCENARIOS)})",
@@ -297,7 +410,7 @@ def main() -> int:
 
     report = {
         "artifact": "FAULTS",
-        "round": 2,
+        "round": 3,
         "all_ok": all(r["ok"] for r in results),
         "scenarios": results,
     }

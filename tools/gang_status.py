@@ -34,11 +34,12 @@ import sys
 import tempfile
 import threading
 import time
-import urllib.error
-import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from machine_learning_apache_spark_tpu.fleet.scrape import (  # noqa: E402
+    scrape as _fleet_scrape,
+)
 from machine_learning_apache_spark_tpu.launcher.monitor import (  # noqa: E402
     read_heartbeat,
 )
@@ -52,22 +53,22 @@ from machine_learning_apache_spark_tpu.telemetry.http import (  # noqa: E402
 HEARTBEAT_RE = re.compile(r"heartbeat_(\d+)$")
 
 
-def scrape(port: int, path: str, timeout: float = 2.0) -> dict | None:
-    """GET one endpoint off a rank's local plane; None on any failure
-    (a dead rank must not kill the whole table)."""
-    url = f"http://127.0.0.1:{port}{path}"
-    try:
-        with urllib.request.urlopen(url, timeout=timeout) as resp:
-            return json.loads(resp.read().decode("utf-8"))
-    except urllib.error.HTTPError as e:
-        # /healthz answers 503 when degraded — still a payload worth
-        # showing.
-        try:
-            return json.loads(e.read().decode("utf-8"))
-        except Exception:
-            return None
-    except Exception:
-        return None
+def scrape(
+    port: int,
+    path: str,
+    timeout: float = 2.0,
+    *,
+    retries: int = 2,
+) -> dict | None:
+    """GET one endpoint off a rank's local plane; None on failure after
+    retries (a dead rank must not kill the whole table). The scrape
+    logic proper lives in ``fleet.scrape`` now — this wrapper keeps the
+    tool's historical signature and defaults retries on, closing the
+    sidecar-discovery race: a rank writes its port sidecar in the same
+    instant its server binds, so a scrape landing a moment early sees
+    one connection-refused and must try again, not report the rank
+    unreachable forever."""
+    return _fleet_scrape(port, path, timeout, retries=retries)
 
 
 def find_heartbeats(directory: str) -> dict[int, str]:
