@@ -24,6 +24,7 @@ from machine_learning_apache_spark_tpu.ingest.packing import OnlinePacker
 from machine_learning_apache_spark_tpu.ingest.pipeline import (
     StreamingPipeline,
     WORKER_PREFIX,
+    rescatter_stream_state,
 )
 from machine_learning_apache_spark_tpu.ingest.readers import (
     ArraySource,
@@ -46,5 +47,6 @@ __all__ = [
     "StreamingPipeline",
     "TextLineSource",
     "WORKER_PREFIX",
+    "rescatter_stream_state",
     "validate_ingest_knobs",
 ]

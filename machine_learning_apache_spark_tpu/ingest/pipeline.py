@@ -583,3 +583,36 @@ class StreamingPipeline:
 
     def __exit__(self, *exc) -> None:
         self.shutdown()
+
+
+def rescatter_stream_state(
+    sd: dict, *, old_world: int, new_world: int, shard: str = "records"
+) -> dict:
+    """Validate and adapt a pipeline ``state_dict`` across a world-size
+    change (elastic resume, ``train/reshard.py``).
+
+    In ``shard="records"`` mode the sidecar state is rank-agnostic by
+    construction — every rank strides the same stream by its own
+    ``(rank, world)`` read from the env at pipeline construction, and
+    batch-count equalization is recomputed per-iteration from the
+    CURRENT world — so the rescatter is adopt-as-is; this function's job
+    is pinning that contract (and failing the one case that breaks it).
+    ``shard="files"`` partitions FILES per rank at construction, so a
+    saved cursor indexes into one old rank's file subset and cannot be
+    re-scattered without re-reading the old partition; elastic resume
+    refuses it loudly rather than silently replaying the wrong files.
+    """
+    if int(new_world) < 1 or int(old_world) < 1:
+        raise ValueError(
+            f"world sizes must be >= 1, got {old_world} -> {new_world}"
+        )
+    if shard == "files":
+        raise ValueError(
+            "ingest stream state from shard='files' is rank-local (each "
+            f"rank cursors its own file subset) and cannot be re-scattered "
+            f"from world {old_world} to world {new_world}; use "
+            "shard='records' for elastic runs or drop the ingest state"
+        )
+    out = dict(sd)
+    out["rescattered"] = {"old_world": int(old_world), "new_world": int(new_world)}
+    return out

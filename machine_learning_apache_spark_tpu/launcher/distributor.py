@@ -161,6 +161,9 @@ class Distributor:
         term_grace: float = 5.0,
         backoff_base: float = 0.5,
         backoff_max: float = 30.0,
+        elastic: bool = False,
+        elastic_min_world: int = 1,
+        rank_restart_budget: int | None = None,
     ) -> None:
         self.num_processes = num_processes or 1
         self.local_mode = local_mode
@@ -245,6 +248,37 @@ class Distributor:
         # gangs on one host don't re-stampede the same resource in lockstep.
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
+        # Elastic shrink policy (docs/FAULT_TOLERANCE.md "Elastic
+        # resume"): when one rank keeps failing past its per-rank restart
+        # budget (`rank_restart_budget`, defaulting to `max_restarts`),
+        # it is judged PERMANENTLY LOST — a preempted chip, a bad host.
+        # With elastic=True the gang retries at world-1 (never below
+        # `elastic_min_world`) instead of raising, and the workers see
+        # MLSPARK_ELASTIC=1 so fit(resume=True) reshards the old world's
+        # checkpoints onto the shrunken mesh (train/reshard.py). With
+        # elastic=False (but a budget set) the exhaustion raises a
+        # GangFailure with permanent=True naming the rank, cause, and
+        # attempt count. Deadline expiries never count against a rank —
+        # they blame the whole gang, not a member.
+        self.elastic = bool(elastic)
+        if int(elastic_min_world) < 1:
+            raise ValueError(
+                f"elastic_min_world must be >= 1, got {elastic_min_world}"
+            )
+        if int(elastic_min_world) > self.num_processes:
+            raise ValueError(
+                f"elastic_min_world={elastic_min_world} exceeds "
+                f"num_processes={self.num_processes}"
+            )
+        self.elastic_min_world = int(elastic_min_world)
+        if rank_restart_budget is not None and int(rank_restart_budget) < 0:
+            raise ValueError(
+                f"rank_restart_budget must be >= 0 or None, got "
+                f"{rank_restart_budget}"
+            )
+        self.rank_restart_budget = (
+            None if rank_restart_budget is None else int(rank_restart_budget)
+        )
 
     # -- multi-host control plane --------------------------------------------
     def commands_for_hosts(
@@ -289,11 +323,17 @@ class Distributor:
 
         try:
             attempt = 0
+            # Per-rank failure counts since the last shrink — the elastic
+            # policy's permanent-loss ledger (deadline expiries excluded:
+            # they blame the gang, not a member).
+            rank_failures: dict[int, int] = {}
             while True:
                 # Clear any stale result/heartbeat files from a failed
                 # attempt so a restart can't return a dead rank's leftovers
-                # (or judge liveness off a corpse's last beat).
-                for rank in range(n):
+                # (or judge liveness off a corpse's last beat). Sweep the
+                # ORIGINAL world's files — after a shrink, a departed
+                # rank's leftovers must not linger either.
+                for rank in range(self.num_processes):
                     for name in (f"result_{rank}.pkl", f"heartbeat_{rank}"):
                         stale = os.path.join(workdir, name)
                         if os.path.exists(stale):
@@ -310,6 +350,74 @@ class Distributor:
                     return value
                 except GangFailure as failure:
                     attempt += 1
+                    budget = (
+                        self.max_restarts
+                        if self.rank_restart_budget is None
+                        else self.rank_restart_budget
+                    )
+                    lost: int | None = None
+                    if failure.rank is not None and failure.cause != "deadline":
+                        rank_failures[failure.rank] = (
+                            rank_failures.get(failure.rank, 0) + 1
+                        )
+                        if rank_failures[failure.rank] > budget:
+                            lost = failure.rank
+                    if lost is not None and (
+                        self.elastic or self.rank_restart_budget is not None
+                    ):
+                        fails = rank_failures[lost]
+                        if not self.elastic:
+                            telemetry.annotate(
+                                "launcher.gang_exhausted",
+                                attempt=attempt, rank=lost,
+                                cause=failure.cause,
+                            )
+                            raise GangFailure(
+                                f"rank {lost} permanently lost "
+                                f"(cause={failure.cause}) after {fails} "
+                                f"failed attempt(s) — per-rank restart "
+                                f"budget {budget} exhausted and elastic "
+                                "resume is disabled",
+                                rank=lost, cause=failure.cause,
+                                attempt=attempt,
+                                exit_code=failure.exit_code,
+                                permanent=True,
+                            ) from failure
+                        if n - 1 < self.elastic_min_world:
+                            telemetry.annotate(
+                                "launcher.gang_exhausted",
+                                attempt=attempt, rank=lost,
+                                cause=failure.cause,
+                            )
+                            raise GangFailure(
+                                f"rank {lost} permanently lost "
+                                f"(cause={failure.cause}) after {fails} "
+                                f"failed attempt(s) and the gang cannot "
+                                f"shrink below elastic_min_world="
+                                f"{self.elastic_min_world} (world is {n})",
+                                rank=lost, cause=failure.cause,
+                                attempt=attempt,
+                                exit_code=failure.exit_code,
+                                permanent=True,
+                            ) from failure
+                        telemetry.annotate(
+                            "launcher.gang_shrink",
+                            old_world=n, new_world=n - 1, rank=lost,
+                            cause=failure.cause, failures=fails,
+                        )
+                        log.warning(
+                            "rank %d permanently lost (cause=%s, %d "
+                            "failure(s) > budget %d); shrinking gang "
+                            "%d -> %d and resuming elastically from the "
+                            "group checkpoints",
+                            lost, failure.cause, fails, budget, n, n - 1,
+                        )
+                        n -= 1
+                        attempt = 0
+                        rank_failures.clear()
+                        time.sleep(min(self.backoff_max, self.backoff_base))
+                        coord = f"127.0.0.1:{_free_port()}"
+                        continue
                     telemetry.annotate(
                         "launcher.gang_retry" if attempt <= self.max_restarts
                         else "launcher.gang_exhausted",
@@ -422,6 +530,12 @@ class Distributor:
             # Observability-plane port knob, same contract shape.
             if self.telemetry_http is not None:
                 env["MLSPARK_TELEMETRY_HTTP"] = str(self.telemetry_http)
+            # Elastic opt-in rides the same contract: the workers' fit()
+            # resolves MLSPARK_ELASTIC when elastic= isn't passed, so a
+            # shrunken gang reshards old-topology checkpoints instead of
+            # refusing them (train/reshard.py).
+            if self.elastic:
+                env["MLSPARK_ELASTIC"] = "1"
             # Ingest knobs ride the same contract: constructor > inherited
             # env (explicit env= still wins below).
             env.update(self.ingest_env)

@@ -59,7 +59,10 @@ class GangFailure(RuntimeError):
       causes like deadline expiry);
     - ``cause`` — ``"exit"`` | ``"heartbeat"`` | ``"deadline"``;
     - ``attempt`` — 0-based gang attempt this failure ended;
-    - ``exit_code`` — the failing rank's exit code (exit cause only).
+    - ``exit_code`` — the failing rank's exit code (exit cause only);
+    - ``permanent`` — the rank exhausted its per-rank restart budget
+      (``Distributor``'s elastic policy judged it permanently lost and
+      either could not shrink further or elastic resume was disabled).
     """
 
     def __init__(
@@ -70,12 +73,14 @@ class GangFailure(RuntimeError):
         cause: str = "exit",
         attempt: int = 0,
         exit_code: int | None = None,
+        permanent: bool = False,
     ):
         super().__init__(message)
         self.rank = rank
         self.cause = cause
         self.attempt = attempt
         self.exit_code = exit_code
+        self.permanent = permanent
 
 
 def _signal_proc(proc: subprocess.Popen, sig: int) -> None:

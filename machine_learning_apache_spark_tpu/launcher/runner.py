@@ -18,7 +18,7 @@ import traceback
 
 
 def _start_heartbeat(
-    path: str, interval: float, rank: int = 0
+    path: str, interval: float, rank: int = 0, world: int | None = None
 ) -> threading.Thread:
     """Rewrite ``path`` every ``interval`` seconds from a daemon thread —
     the liveness signal ``launcher.monitor.GangMonitor`` watches (by
@@ -70,6 +70,10 @@ def _start_heartbeat(
                     "phase": b.get("phase"),
                     "step": b.get("step"),
                     "http_port": b.get("http_port"),
+                    # World size as this worker sees it — after an
+                    # elastic shrink the scrape tables show the gang's
+                    # CURRENT world, not the launch-time one.
+                    "world": world,
                 }
                 tmp = f"{path}.tmp.{os.getpid()}"
                 try:
@@ -135,10 +139,12 @@ def main(argv: list[str] | None = None) -> int:
     # framework imports so rendezvous/import time is covered too.
     heartbeat_file = os.environ.get("MLSPARK_HEARTBEAT_FILE")
     if heartbeat_file:
+        world_raw = os.environ.get("MLSPARK_NUM_PROCESSES")
         _start_heartbeat(
             heartbeat_file,
             float(os.environ.get("MLSPARK_HEARTBEAT_INTERVAL", "1.0")),
             rank=rank,
+            world=int(world_raw) if world_raw else None,
         )
 
     args, kwargs = ((), {})

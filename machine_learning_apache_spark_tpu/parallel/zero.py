@@ -826,6 +826,24 @@ def _make_hybrid_step(
     return step
 
 
+def plan_layout(plan: _FlatPlan) -> dict:
+    """JSON-safe bucket layout of a plan's flat vector — the ``layout``
+    record of the checkpoint topology stamp, and the input
+    ``train.reshard.BucketLayout.from_json`` consumes for cross-topology
+    resharding. ``world`` is the flat shard count (``axis_size *
+    model_ways`` on a hybrid mesh), recoverable as ``padded /
+    shard_len``; the treedef/leaf shapes are deliberately excluded
+    (resharding is pure byte-range redistribution and never needs them).
+    """
+    return {
+        "total": int(plan.total),
+        "world": int(plan.padded // plan.shard_len),
+        "padded": int(plan.padded),
+        "shard_len": int(plan.shard_len),
+        "buckets": [[int(s), int(e)] for s, e in plan.buckets],
+    }
+
+
 def opt_state_bytes(opt_state) -> int:
     """Logical (unsharded) byte size of an optimizer-state tree — the
     replicated-mode per-chip footprint."""
@@ -867,6 +885,7 @@ __all__ = [
     "make_zero1_step",
     "opt_state_bytes",
     "opt_state_bytes_per_chip",
+    "plan_layout",
     "resolve_dp_mode",
     "shard_optimizer_state",
 ]

@@ -17,6 +17,14 @@ from machine_learning_apache_spark_tpu.train.checkpoint import (
     load_params,
     save_params,
 )
+from machine_learning_apache_spark_tpu.train.reshard import (
+    BucketLayout,
+    TopologyMismatch,
+    elastic_restore,
+    gather_spec,
+    reshard_flat,
+    reshard_flat_oracle,
+)
 from machine_learning_apache_spark_tpu.train.loop import (
     FitResult,
     classification_loss,
@@ -39,6 +47,12 @@ __all__ = [
     "CheckpointManager",
     "load_params",
     "save_params",
+    "BucketLayout",
+    "TopologyMismatch",
+    "elastic_restore",
+    "gather_spec",
+    "reshard_flat",
+    "reshard_flat_oracle",
     "FitResult",
     "classification_loss",
     "evaluate",

@@ -216,6 +216,7 @@ def fit(
     steps_per_call: int = 1,
     prefetch_to_device: int = 0,
     resume: bool = False,
+    elastic: bool | None = None,
 ) -> FitResult:
     """The canonical loop (``pytorch_cnn.py:125-146`` shape): epochs × batches,
     per-``log_every``-batch loss/time prints
@@ -299,6 +300,19 @@ def fit(
     an uninterrupted one from the last checkpoint onward. No checkpoint
     on disk -> a normal fresh run; ``FitResult.resumed_step`` records
     which happened.
+
+    Every checkpoint sidecar carries a topology stamp (world size, mesh
+    axes, dp mode, ZeRO-1 bucket layout). A resume whose own topology
+    matches restores bit-identically as above; on mismatch, ``elastic``
+    decides (arg > ``MLSPARK_ELASTIC`` env — set by
+    ``Distributor(elastic=True)`` — > off): disabled raises
+    ``TopologyMismatch`` naming both topologies (a wrong-world resume
+    must never silently misload per-rank shards); enabled routes the
+    restore through ``train/reshard.py`` — the old gang's per-rank flat
+    optimizer shards are reassembled and resharded onto this run's mesh,
+    params/rng/epoch adopt, and the ingest stream state is re-scattered
+    (equalization recomputes for the new shard count). See
+    docs/FAULT_TOLERANCE.md "Elastic resume".
 
     The input ``state``'s buffers are CONSUMED (the fused step donates them
     for in-place updates); use ``FitResult.state``, never the argument,
@@ -391,9 +405,34 @@ def fit(
     resume_meta: dict = {}
     start_epoch = 0
     if resume and checkpointer is not None:
+        from machine_learning_apache_spark_tpu.train import (
+            checkpoint as _ckpt,
+            reshard as _reshard,
+        )
+
         # After shard_state so the restore template carries the run's real
         # layout — orbax restores straight into the sharded buffers.
-        restored = checkpointer.restore_latest_valid(state)
+        # Topology is validated BEFORE any restore: a cross-topology
+        # attempt would fail shapes-first (or worse, misload), so the
+        # stamp decides the route up front.
+        current = _ckpt.topology_stamp(state)
+        old = checkpointer.newest_topology_stamp()
+        crossed = old is not None and not _ckpt.same_topology(old, current)
+        if crossed:
+            if not _reshard.resolve_elastic(elastic):
+                raise _reshard.TopologyMismatch(
+                    f"checkpoints under {checkpointer.directory} were "
+                    f"written by a different topology — checkpoint "
+                    f"topology {old} vs this run's {current}. Pass "
+                    "elastic=True (or set MLSPARK_ELASTIC=1, which "
+                    "Distributor(elastic=True) does) to reshard, or "
+                    "point the run at a fresh checkpoint directory."
+                )
+            restored = _reshard.elastic_restore(
+                checkpointer, state, old_stamp=old
+            )
+        else:
+            restored = checkpointer.restore_latest_valid(state)
         if restored is not None:
             state, resumed_step, resume_meta = restored
             if "rng" in resume_meta:
@@ -403,7 +442,35 @@ def fit(
                 # Stream position (mixture RNG state, per-source cursors)
                 # from the sidecar: the resumed run replays the exact
                 # batch sequence the interrupted one would have produced.
-                train_loader.load_state_dict(resume_meta["ingest"])
+                ingest_state = resume_meta["ingest"]
+                if crossed:
+                    from machine_learning_apache_spark_tpu.ingest import (
+                        rescatter_stream_state,
+                    )
+
+                    ingest_state = rescatter_stream_state(
+                        ingest_state,
+                        old_world=int(old.get("world_size", 1)),
+                        new_world=int(current.get("world_size", 1)),
+                        shard=getattr(train_loader, "shard", "records"),
+                    )
+                train_loader.load_state_dict(ingest_state)
+            if crossed:
+                telemetry.annotate(
+                    "train.elastic_resume",
+                    step=int(resumed_step),
+                    old_world=int(old.get("world_size", 1)),
+                    new_world=int(current.get("world_size", 1)),
+                    old_mesh=old.get("mesh"),
+                    new_mesh=current.get("mesh"),
+                    dp_mode=current.get("dp_mode"),
+                )
+                emit(
+                    f"elastic resume: resharded checkpoint step "
+                    f"{resumed_step} from world "
+                    f"{old.get('world_size')} onto world "
+                    f"{current.get('world_size')}"
+                )
             emit(
                 f"resuming from checkpoint step {resumed_step} "
                 f"(starting epoch {start_epoch})"

@@ -1,0 +1,503 @@
+"""Cross-topology checkpoint resharding — elastic resume (ROADMAP item 3).
+
+Fault tolerance restarts a failed gang whole, at the same world size; a
+preempted 8-chip job could never come back as 4 chips — exactly what
+production preemption looks like. This module closes that gap: it maps
+an N-rank sharded checkpoint (params + flat bucketed ZeRO-1 optimizer
+slices + rng + ingest stream state) onto an M-rank gang, M < N or
+M > N, including hybrid data x model meshes where only the data axis
+changes.
+
+Why it is tractable: the ZeRO-1 moments are flat 1-D fp32 vectors in
+**bucket-major shard order** (``parallel.zero``: device ``i`` owns the
+``i``-th 1/N slice of every bucket, concatenated), so re-mapping between
+world sizes is pure byte-range redistribution — the portable-collective
+formulation of "Memory-efficient array redistribution" (arxiv
+2112.01075) — not a per-leaf puzzle. The stored vector is a
+*permutation* of the logical flat vector that depends on ``(world,
+buckets)``; both the padding and the bucket boundaries change with the
+world size, so the remap un-permutes through the source
+:class:`BucketLayout` and re-permutes through the destination one:
+
+    stored[i * shard_len + base_k + t]  <->  logical[s_k + i * piece_k + t]
+
+where bucket ``k`` spans ``[s_k, e_k)``, ``piece_k = (e_k - s_k) /
+world`` and ``base_k`` is the cumulative piece length of earlier
+buckets. :func:`gather_spec` intersects the two piecewise-linear maps
+into contiguous ``(src_shard, src_off, dst_off, length)`` copies;
+:func:`reshard_flat` applies them, and :func:`reshard_flat_oracle` is
+the bit-exact single-host reference that reconstructs the logical
+vector explicitly (the tests pin the two equal to the bit).
+
+The run-level entry point is :func:`elastic_restore`: given a
+:class:`~machine_learning_apache_spark_tpu.train.checkpoint.CheckpointManager`
+whose directory follows the gang's ``ckpt_r<rank>`` group convention
+and the old run's topology stamp (the ``meta_<step>.json`` sidecar's
+``topology`` record), it agrees on one complete step across every old
+rank directory, reads each rank's local shard payload, reshards the
+flat optimizer leaves onto the new mesh, and reattaches everything into
+the new run's (differently-sharded) template state. Params are
+replicated under ZeRO-1, and rng/epoch/ingest sidecar state is
+SPMD-identical across ranks, so those adopt directly; ingest
+equalization is a function of the *current* world size and recomputes
+on the new shard count (``ingest.rescatter_stream_state`` guards the
+one genuinely rank-local case, ``shard='files'``).
+
+Env contract (docs/FAULT_TOLERANCE.md "Elastic resume"):
+``MLSPARK_ELASTIC=1`` — set by ``Distributor(elastic=True)`` in every
+worker — lets ``fit(resume=True)`` route a topology-mismatched resume
+through this module instead of raising :class:`TopologyMismatch`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from machine_learning_apache_spark_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ENV_ELASTIC = "MLSPARK_ELASTIC"
+
+
+def resolve_elastic(elastic: bool | None) -> bool:
+    """Explicit argument > ``MLSPARK_ELASTIC`` env > False (the launcher
+    gang plumbing: ``Distributor(elastic=True)`` sets the env var in
+    every worker)."""
+    if elastic is not None:
+        return bool(elastic)
+    raw = os.environ.get(ENV_ELASTIC)
+    if raw is None:
+        return False
+    return raw.strip().lower() in ("1", "true", "on", "yes")
+
+
+class TopologyMismatch(RuntimeError):
+    """A resume found checkpoints written under a different topology and
+    elastic resume is disabled. The message names BOTH topologies — a
+    wrong-world resume must never silently misload per-rank shards."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLayout:
+    """Static description of how one flat fp32 vector is cut into
+    bucket-major shards — the checkpoint-portable core of ``zero.py``'s
+    ``_FlatPlan`` (no treedef/leaf shapes: resharding never needs them).
+
+    ``world`` is the number of FLAT SHARDS (``axis_size * model_ways``
+    on a hybrid mesh), not the process count: a data-axis-only change on
+    a hybrid mesh is just a different ``world`` here.
+    """
+
+    total: int
+    world: int
+    padded: int
+    shard_len: int
+    buckets: tuple  # ((start, stop), ...) in flat padded coordinates
+
+    def __post_init__(self) -> None:
+        if self.padded != self.shard_len * self.world:
+            raise ValueError(
+                f"inconsistent layout: padded={self.padded} != "
+                f"shard_len={self.shard_len} * world={self.world}"
+            )
+        stops = [0] + [e for _, e in self.buckets]
+        starts = [s for s, _ in self.buckets] + [self.padded]
+        if stops[:-1] != starts[: len(stops) - 1] or stops[-1] != self.padded:
+            raise ValueError(
+                f"buckets {self.buckets} do not partition [0, {self.padded})"
+            )
+        for s, e in self.buckets:
+            if (e - s) % self.world:
+                raise ValueError(
+                    f"bucket ({s}, {e}) does not tile world={self.world}"
+                )
+
+    @classmethod
+    def create(cls, total: int, world: int, bucket_bytes: int) -> "BucketLayout":
+        """Mirror of ``zero.make_flat_plan``'s arithmetic (the tests pin
+        the two equal): fp32-denominated bucket element counts rounded
+        up to a multiple of the world, padding in the last bucket."""
+        elems = max(bucket_bytes // 4, 1)
+        elems = -(-elems // world) * world
+        padded = -(-total // world) * world
+        buckets = tuple(
+            (start, min(start + elems, padded))
+            for start in range(0, padded, elems)
+        )
+        return cls(
+            total=total, world=world, padded=padded,
+            shard_len=padded // world, buckets=buckets,
+        )
+
+    @classmethod
+    def from_json(cls, data: dict) -> "BucketLayout":
+        """Inverse of ``zero.plan_layout`` (the topology stamp's
+        ``layout`` record)."""
+        return cls(
+            total=int(data["total"]),
+            world=int(data["world"]),
+            padded=int(data["padded"]),
+            shard_len=int(data["shard_len"]),
+            buckets=tuple((int(s), int(e)) for s, e in data["buckets"]),
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "total": self.total, "world": self.world, "padded": self.padded,
+            "shard_len": self.shard_len,
+            "buckets": [[s, e] for s, e in self.buckets],
+        }
+
+    def segments(self) -> Iterable[tuple[int, int, int, int]]:
+        """Yield ``(logical_lo, logical_hi, shard, stored_off)``: shard
+        ``shard`` stores logical ``[lo, hi)`` at ``stored_off`` within
+        its ``shard_len`` vector. Together the segments cover
+        ``[0, padded)`` exactly once."""
+        base = 0  # cumulative piece length of earlier buckets
+        for s, e in self.buckets:
+            piece = (e - s) // self.world
+            for i in range(self.world):
+                yield (s + i * piece, s + (i + 1) * piece, i, base)
+            base += piece
+
+
+def gather_spec(
+    src: BucketLayout, dst: BucketLayout
+) -> tuple[tuple[tuple[int, int, int, int], ...], ...]:
+    """The resharded gather, as data: for every destination shard, the
+    contiguous copies ``(src_shard, src_off, dst_off, length)`` (element
+    units; multiply by the itemsize for byte ranges) that assemble it
+    from the source shards.
+
+    Only logical positions ``< total`` are copied: source padding is
+    dropped and destination padding stays zero (the caller zero-fills),
+    so layouts with different ``padded`` compose. Copies are produced by
+    intersecting the two layouts' piecewise-linear stored<->logical maps
+    — each overlap of a src segment with a dst segment is one contiguous
+    run in both stored vectors.
+    """
+    if src.total != dst.total:
+        raise ValueError(
+            f"layouts describe different vectors: src total {src.total} "
+            f"!= dst total {dst.total}"
+        )
+    src_segs = sorted(src.segments())  # sorted by logical_lo
+    out: list[tuple] = []
+    for j in range(dst.world):
+        copies: list[tuple[int, int, int, int]] = []
+        for dlo, dhi, shard, dbase in dst.segments():
+            if shard != j:
+                continue
+            dhi = min(dhi, dst.total)
+            for slo, shi, i, sbase in src_segs:
+                lo, hi = max(dlo, slo), min(dhi, shi)
+                if lo < hi:
+                    copies.append(
+                        (i, sbase + (lo - slo), dbase + (lo - dlo), hi - lo)
+                    )
+        copies.sort(key=lambda c: c[2])
+        out.append(tuple(copies))
+    return tuple(out)
+
+
+def spec_byte_ranges(
+    spec: Sequence[Sequence[tuple[int, int, int, int]]], itemsize: int = 4
+) -> tuple[tuple[tuple[int, int, int, int], ...], ...]:
+    """The same gather expressed over bucket BYTE ranges (what a remote
+    blob-range reader would fetch): every offset/length scaled by the
+    element ``itemsize`` (fp32 master vectors: 4)."""
+    return tuple(
+        tuple((i, so * itemsize, do * itemsize, ln * itemsize)
+              for i, so, do, ln in copies)
+        for copies in spec
+    )
+
+
+def reshard_flat(
+    shards: Sequence[np.ndarray],
+    src: BucketLayout,
+    dst: BucketLayout,
+    spec=None,
+) -> list[np.ndarray]:
+    """Redistribute a stored flat vector from ``src``'s N shards to
+    ``dst``'s M shards by applying :func:`gather_spec`'s byte-range
+    copies. Destination padding is zero (matching what ``zero.py``'s
+    fused step maintains: the pad never accumulates nonzero state under
+    an elementwise optimizer fed zero pad gradients)."""
+    if len(shards) != src.world:
+        raise ValueError(f"expected {src.world} shards, got {len(shards)}")
+    arrs = [np.asarray(s) for s in shards]
+    for i, a in enumerate(arrs):
+        if a.shape != (src.shard_len,):
+            raise ValueError(
+                f"shard {i} has shape {a.shape}, expected ({src.shard_len},)"
+            )
+    dtype = arrs[0].dtype
+    spec = gather_spec(src, dst) if spec is None else spec
+    out = [np.zeros(dst.shard_len, dtype=dtype) for _ in range(dst.world)]
+    for j, copies in enumerate(spec):
+        for i, so, do, ln in copies:
+            out[j][do:do + ln] = arrs[i][so:so + ln]
+    return out
+
+
+def reshard_flat_oracle(
+    shards: Sequence[np.ndarray], src: BucketLayout, dst: BucketLayout
+) -> list[np.ndarray]:
+    """Bit-exact single-host reference: reconstruct the LOGICAL vector
+    explicitly through ``src``'s coordinate map, then scatter it through
+    ``dst``'s. ``reshard_flat`` must agree to the bit (tests pin it);
+    this form is O(padded) memory, the gather form streams ranges."""
+    arrs = [np.asarray(s) for s in shards]
+    logical = np.zeros(src.padded, dtype=arrs[0].dtype)
+    for lo, hi, i, base in src.segments():
+        logical[lo:hi] = arrs[i][base:base + (hi - lo)]
+    logical = logical[:src.total]
+    out = [np.zeros(dst.shard_len, dtype=logical.dtype) for _ in range(dst.world)]
+    for lo, hi, j, base in dst.segments():
+        hi = min(hi, dst.total)
+        if lo < hi:
+            out[j][base:base + (hi - lo)] = logical[lo:hi]
+    return out
+
+
+# -- run-level elastic restore ------------------------------------------------
+
+def _is_flat_opt_leaf(template_leaf, layout: BucketLayout | None) -> bool:
+    """A ZeRO-1 flat moment vector: 1-D, exactly the padded length of
+    the run's layout. Scalar counts and any other opt leaves replicate."""
+    return (
+        layout is not None
+        and getattr(template_leaf, "ndim", 0) == 1
+        and int(template_leaf.shape[0]) == layout.padded
+    )
+
+
+def _stamp_layout(stamp: dict | None) -> BucketLayout | None:
+    if not stamp or not stamp.get("layout"):
+        return None
+    return BucketLayout.from_json(stamp["layout"])
+
+
+def elastic_restore(
+    checkpointer, template, *, old_stamp: dict, step: int | None = None
+):
+    """Restore an old-topology checkpoint group into ``template`` (the
+    NEW topology's state). Returns ``(state, step, meta)`` like
+    ``CheckpointManager.restore_latest_valid``, or None when the group
+    has no complete step to agree on.
+
+    - the step is the group-durable one: the newest step whose data is
+      finalized in every OLD rank's directory (orbax finalization is
+      atomic, so a plain step directory is complete even when the dead
+      rank's ``latest`` pointer never flushed), preferring a step whose
+      authority sidecar (rng / epoch / topology) survives;
+    - flat optimizer vectors are reassembled from every old rank's local
+      shard payload and resharded through ``gather_spec``; everything
+      else (params under ZeRO-1, scalar counts, step) is replicated and
+      adopts from the lowest old rank;
+    - the returned ``meta`` is the agreed step's sidecar (rng / epoch /
+      ingest state are SPMD-identical across ranks — the caller reuses
+      its normal resume path on it).
+    """
+    import jax
+
+    from machine_learning_apache_spark_tpu.train import checkpoint as _ckpt
+
+    old_world = int(old_stamp.get("world_size", 1))
+    dirs = checkpointer.group_rank_dirs()
+    if dirs is None:
+        if old_world != 1:
+            raise TopologyMismatch(
+                f"checkpoint stamp names a {old_world}-rank gang but "
+                f"{checkpointer.directory!r} does not follow the "
+                "ckpt_r<rank> group convention — the peer rank "
+                "directories cannot be located for resharding"
+            )
+        dirs = {0: checkpointer.directory}
+    missing = [r for r in range(old_world) if r not in dirs]
+    if missing:
+        raise TopologyMismatch(
+            f"elastic resume needs every old rank's checkpoint directory; "
+            f"missing ckpt_r<k> for ranks {missing} of the old "
+            f"{old_world}-rank gang"
+        )
+    if step is None:
+        chosen = _agreed_step_and_stamp(dirs, old_stamp)
+        if chosen is None:
+            log.warning(
+                "elastic resume found no step durable on every rank of the "
+                "old %d-rank group; starting fresh", old_world,
+            )
+            return None
+        step, stamp = chosen
+        stamp_world = int(stamp.get("world_size", old_world))
+        if stamp_world != old_world:
+            # Repeated shrinks can leave the newest sidecar naming a gang
+            # whose own checkpoint never became group-durable; the agreed
+            # step's OWN stamp is the layout its payload was written under.
+            log.info(
+                "elastic resume: newest stamp names a %d-rank gang but the "
+                "agreed step %d was written by a %d-rank gang; resharding "
+                "from the step's own topology", old_world, step, stamp_world,
+            )
+            old_stamp, old_world = stamp, stamp_world
+    old_dirs = {r: dirs[r] for r in range(old_world)}
+
+    new_stamp = _ckpt.topology_stamp(template)
+    if old_stamp.get("dp_mode", "replicated") != new_stamp.get("dp_mode"):
+        raise TopologyMismatch(
+            f"cannot reshard across dp modes: checkpoint was "
+            f"{old_stamp.get('dp_mode')!r}, this run is "
+            f"{new_stamp.get('dp_mode')!r}"
+        )
+    src = _stamp_layout(old_stamp)
+    dst = _stamp_layout(new_stamp)
+    if (src is None) != (dst is None):
+        raise TopologyMismatch(
+            f"checkpoint layout {old_stamp.get('layout')} is incompatible "
+            f"with this run's layout {new_stamp.get('layout')}"
+        )
+    if src is not None and src.total != dst.total:
+        raise TopologyMismatch(
+            f"checkpoint flat vector has {src.total} elements, this run's "
+            f"has {dst.total} — different model/optimizer, not a topology "
+            "change"
+        )
+
+    target = _ckpt.detached_payload(template)
+    if src is not None:
+        if src.world % old_world:
+            raise TopologyMismatch(
+                f"old layout world {src.world} does not divide over "
+                f"{old_world} processes"
+            )
+        per_old = src.world // old_world
+        local_len = src.shard_len * per_old
+        # Per-old-rank restore target: same tree, flat vectors swapped
+        # for that rank's local shard length.
+        def _old_target():
+            return jax.tree.map(
+                lambda t, leaf: (
+                    np.zeros(local_len, dtype=t.dtype)
+                    if _is_flat_opt_leaf(leaf, dst) else t
+                ),
+                target, _template_payload(template),
+            )
+    else:
+        def _old_target():
+            return {k: v for k, v in target.items()}
+
+    payloads = {}
+    for r in sorted(old_dirs):
+        payloads[r] = _ckpt.read_raw_payload(old_dirs[r], step, _old_target())
+        if src is None:
+            # Replicated state: one rank's payload is the whole state.
+            break
+
+    base = payloads[min(payloads)]
+    if src is not None:
+        spec = gather_spec(src, dst)
+        # Old rank r stored its local chunk of the flat vector: `per_old`
+        # consecutive shards (process-major device order), shard_len each.
+        tmpl_opt_leaves, opt_treedef = jax.tree.flatten(template.opt_state)
+        per_rank_opt = {
+            r: jax.tree.flatten(payloads[r]["opt_state"])[0]
+            for r in payloads
+        }
+        new_opt_leaves = []
+        for li, tmpl_leaf in enumerate(tmpl_opt_leaves):
+            if not _is_flat_opt_leaf(tmpl_leaf, dst):
+                new_opt_leaves.append(per_rank_opt[min(payloads)][li])
+                continue
+            shards = []
+            for r in sorted(payloads):
+                flat = np.asarray(per_rank_opt[r][li])
+                shards.extend(
+                    flat[c * src.shard_len:(c + 1) * src.shard_len]
+                    for c in range(per_old)
+                )
+            new_shards = reshard_flat(shards, src, dst, spec=spec)
+            new_opt_leaves.append(np.concatenate(new_shards))
+        new_opt = jax.tree.unflatten(opt_treedef, new_opt_leaves)
+    else:
+        new_opt = base["opt_state"]
+
+    state = template.replace(
+        step=_ckpt.attach_local(base["step"], _template_payload(template)["step"]),
+        params=jax.tree.map(
+            _ckpt.attach_local, base["params"], _template_payload(template)["params"]
+        ),
+        opt_state=jax.tree.map(
+            _ckpt.attach_local, new_opt, _template_payload(template)["opt_state"]
+        ),
+    )
+    meta = _ckpt.read_meta_at(old_dirs[min(old_dirs)], step)
+    log.info(
+        "elastic restore: step %d resharded from %d-rank layout onto %s",
+        step, old_world, new_stamp.get("world_size"),
+    )
+    return state, int(step), meta
+
+
+def _agreed_step_and_stamp(dirs, fallback_stamp):
+    """Pick the restore step and the topology it was actually written
+    under, TOGETHER. Scans the authority (lowest-rank) directory's
+    sidecars newest-first and accepts the first step that is durable in
+    every directory of the gang named by that step's own stamp — after
+    repeated shrinks the newest sidecar and the newest group-durable step
+    can name different world sizes, and resharding a payload with the
+    wrong layout would interleave shards from the wrong ranks. Falls
+    back to the plain durable-data intersection under ``fallback_stamp``
+    when no stamped step qualifies (e.g. every sidecar was lost with the
+    crashed ranks)."""
+    from machine_learning_apache_spark_tpu.train import checkpoint as _ckpt
+
+    auth = dirs[min(dirs)]
+    durable = {r: _ckpt.durable_steps_of(d) for r, d in dirs.items()}
+    for s in _ckpt.sidecar_steps_of(auth):
+        meta = _ckpt.read_meta_at(auth, s) or {}
+        stamp = meta.get("topology")
+        if not stamp:
+            continue
+        w = int(stamp.get("world_size", 1))
+        if any(r not in dirs for r in range(w)):
+            continue
+        if all(s in durable[r] for r in range(w)):
+            return s, stamp
+    w = int(fallback_stamp.get("world_size", 1))
+    if any(r not in dirs for r in range(w)):
+        return None
+    s = _ckpt.group_durable_step({r: dirs[r] for r in range(w)})
+    return (s, fallback_stamp) if s is not None else None
+
+
+def _template_payload(template) -> dict:
+    """The live (jax.Array) payload tree matching the checkpoint payload
+    shape — what ``attach_local`` needs as its per-leaf template."""
+    import jax
+
+    return {
+        "step": template.step if hasattr(template.step, "sharding")
+        else np.int64(jax.device_get(template.step)),
+        "params": template.params,
+        "opt_state": template.opt_state,
+    }
+
+
+__all__ = [
+    "ENV_ELASTIC",
+    "BucketLayout",
+    "TopologyMismatch",
+    "elastic_restore",
+    "gather_spec",
+    "reshard_flat",
+    "reshard_flat_oracle",
+    "resolve_elastic",
+    "spec_byte_ranges",
+]

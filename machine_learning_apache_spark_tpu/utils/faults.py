@@ -20,7 +20,12 @@ Grammar (``MLSPARK_FAULTS``, semicolon-separated)::
 Sites are the instrumented ``maybe_fault(site, ...)`` call points:
 ``train_step`` (train.loop, per optimizer step) and ``decode_batch``
 (serving.engine, per formed batch). ``rank`` matches
-``MLSPARK_PROCESS_ID`` (absent -> matches any process).
+``MLSPARK_PROCESS_ID`` (absent -> matches any process); ``world``
+matches ``MLSPARK_NUM_PROCESSES`` — the elastic-drill lever: a plan
+like ``crash@train_step:world=8,rank=7,...;crash@train_step:world=7,
+rank=6,...`` kills one rank per world size, so each shrunken gang
+meets exactly its own fault and the drill walks 8 -> 7 -> 6
+deterministically.
 
 **One-shot semantics.** A fault fires once. In-process that's a set of
 fired keys; across process restarts (the gang-retry case — the retried
@@ -73,6 +78,7 @@ class FaultSpec:
     rank: int | None = None
     step: int | None = None
     batch: int | None = None
+    world: int | None = None
     exit_code: int = 23
 
     @property
@@ -83,13 +89,17 @@ class FaultSpec:
             f"_r{'any' if self.rank is None else self.rank}"
             f"_s{'any' if self.step is None else self.step}"
             f"_b{'any' if self.batch is None else self.batch}"
+            + ("" if self.world is None else f"_w{self.world}")
         )
 
     def matches(self, site: str, rank: int | None, step: int | None,
-                batch: int | None) -> bool:
+                batch: int | None, world: int | None = None) -> bool:
         if self.site != site:
             return False
-        for want, got in ((self.rank, rank), (self.step, step), (self.batch, batch)):
+        for want, got in (
+            (self.rank, rank), (self.step, step), (self.batch, batch),
+            (self.world, world),
+        ):
             if want is not None and want != got:
                 return False
         return True
@@ -121,7 +131,7 @@ class FaultPlan:
             fields: dict = {"action": action, "site": site}
             for kv in filter(None, (p.strip() for p in kvs.split(","))):
                 k, _, v = kv.partition("=")
-                if k not in ("rank", "step", "batch", "exit_code"):
+                if k not in ("rank", "step", "batch", "world", "exit_code"):
                     raise ValueError(f"unknown fault field {k!r} in {entry!r}")
                 fields[k] = int(v)
             specs.append(FaultSpec(**fields))
@@ -165,11 +175,15 @@ class FaultPlan:
             os.replace(tmp, os.path.join(self.marker_dir, spec.key))
 
     def pending(self, site: str, *, rank: int | None = None,
-                step: int | None = None, batch: int | None = None) -> FaultSpec | None:
+                step: int | None = None, batch: int | None = None,
+                world: int | None = None) -> FaultSpec | None:
         """The first matching not-yet-fired spec, or None. Marks it fired."""
         with self._lock:
             for spec in self.specs:
-                if spec.matches(site, rank, step, batch) and not self._already_fired(spec):
+                if (
+                    spec.matches(site, rank, step, batch, world)
+                    and not self._already_fired(spec)
+                ):
                     self._mark_fired(spec)
                     return spec
         return None
@@ -217,16 +231,25 @@ def _env_rank() -> int | None:
     return int(v) if v is not None else None
 
 
+def _env_world() -> int | None:
+    v = os.environ.get("MLSPARK_NUM_PROCESSES")
+    return int(v) if v is not None else None
+
+
 def maybe_fault(site: str, *, step: int | None = None,
-                batch: int | None = None, rank: int | None = None) -> None:
+                batch: int | None = None, rank: int | None = None,
+                world: int | None = None) -> None:
     """Instrumentation point: fire the first pending fault matching this
     site/coordinate, else return immediately. ``rank`` defaults to this
-    process's ``MLSPARK_PROCESS_ID``."""
+    process's ``MLSPARK_PROCESS_ID``, ``world`` to
+    ``MLSPARK_NUM_PROCESSES`` (how elastic drills pin a fault to one
+    world size along the shrink path)."""
     plan = active_plan()
     if plan is None:
         return
     spec = plan.pending(
-        site, rank=_env_rank() if rank is None else rank, step=step, batch=batch
+        site, rank=_env_rank() if rank is None else rank, step=step,
+        batch=batch, world=_env_world() if world is None else world,
     )
     if spec is None:
         return

@@ -33,6 +33,21 @@ def flaky_until(marker_path):
     return {"attempt": "recovered"}
 
 
+def fail_rank(target=1):
+    """Exit nonzero on the targeted rank of the CURRENT world; everyone
+    else returns their coordinates (plus the elastic env contract). The
+    always-failing rank for the elastic-policy tests: once a shrink
+    removes it from the world, the gang succeeds."""
+    rank = int(os.environ.get("MLSPARK_PROCESS_ID", "0"))
+    if rank == int(target):
+        raise RuntimeError(f"rank {rank} exploded (injected permanent loss)")
+    return {
+        "rank": rank,
+        "world": int(os.environ.get("MLSPARK_NUM_PROCESSES", "1")),
+        "elastic_env": os.environ.get("MLSPARK_ELASTIC"),
+    }
+
+
 def unpicklable_result():
     return lambda: None  # cannot cross the result-file boundary
 
@@ -237,4 +252,89 @@ def echo_telemetry_http():
     return {
         "telemetry_http": os.environ.get("MLSPARK_TELEMETRY_HTTP"),
         "rank": int(os.environ.get("MLSPARK_PROCESS_ID", "-1")),
+    }
+
+
+def elastic_drill_train(workdir, epochs=4, checkpoint_every=1,
+                        global_batch=168, steps_per_epoch=2):
+    """Elastic-resume workload for the shrink drill: ZeRO-1 training over
+    the gang-wide ``data`` mesh with per-rank checkpoint directories and
+    ``fit(resume=True)``. Elastic resume itself is resolved through the
+    env contract — ``Distributor(elastic=True)`` sets ``MLSPARK_ELASTIC=1``
+    — so a shrunken retry reshards the surviving group automatically.
+
+    The default ``global_batch=168 = lcm(8, 7, 6)`` divides every world
+    size on the 8 -> 7 -> 6 shrink path: each world slices the SAME
+    global rows per step, so the batch schedule (and hence the loss
+    trajectory, up to collective reduction order) is world-independent —
+    the drill's loss-parity acceptance check depends on it.
+    ``bucket_bytes=128`` forces multiple ZeRO-1 buckets, so the reshard
+    crosses bucket seams, not just shard boundaries."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from machine_learning_apache_spark_tpu.models import MLP
+    from machine_learning_apache_spark_tpu.parallel import make_mesh
+    from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS
+    from machine_learning_apache_spark_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from machine_learning_apache_spark_tpu.train.loop import fit
+    from machine_learning_apache_spark_tpu.train.losses import cross_entropy
+    from machine_learning_apache_spark_tpu.train.state import (
+        TrainState,
+        make_optimizer,
+    )
+
+    rank, world = jax.process_index(), jax.process_count()
+    if global_batch % world:
+        raise ValueError(
+            f"global_batch {global_batch} must divide world {world}"
+        )
+    rng = np.random.default_rng(7)
+    n = global_batch * steps_per_epoch
+    feats = rng.normal(size=(n, 4)).astype(np.float32)
+    labels = rng.integers(0, 3, n).astype(np.int64)
+    per = global_batch // world
+    loader = []
+    for s in range(steps_per_epoch):
+        rows = slice(s * global_batch, (s + 1) * global_batch)
+        gx, gy = feats[rows], labels[rows]
+        loader.append(
+            (gx[rank * per:(rank + 1) * per], gy[rank * per:(rank + 1) * per])
+        )
+
+    model = MLP(layers=(4, 8, 3))
+    params = model.init(jax.random.key(0), jnp.ones((1, 4)))["params"]
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_optimizer("adam", 0.05)
+    )
+
+    def loss_fn(p, batch, step_rng):
+        del step_rng
+        x, y = batch
+        return cross_entropy(model.apply({"params": p}, x), y), {}
+
+    mesh = make_mesh({DATA_AXIS: world})
+    with CheckpointManager(os.path.join(workdir, f"ckpt_r{rank}")) as ckpt:
+        res = fit(
+            state, loss_fn, loader,
+            epochs=epochs,
+            mesh=mesh,
+            dp_mode="zero1",
+            dp_bucket_bytes=128,
+            checkpointer=ckpt,
+            checkpoint_every=checkpoint_every,
+            resume=True,
+            log_every=0,
+        )
+    return {
+        "rank": rank,
+        "world": world,
+        "final_loss": res.final_loss,
+        "resumed_step": res.resumed_step,
+        "epochs_run": len(res.history),
     }

@@ -406,3 +406,189 @@ class TestParamsOnly:
         save_params(str(tmp_path / "p"), state.params)
         loaded = load_params(str(tmp_path / "p"), state.params)
         assert_trees_equal(loaded, state.params)
+
+
+class TestDurabilityHelpers:
+    """The on-disk building blocks of elastic/group resume: finalized
+    step enumeration (orbax renames atomically, so a plain integer dir
+    IS complete data), sidecar enumeration, and the durable-intersection
+    agreed step."""
+
+    def _fake_rank_dir(self, root, name, steps, tmp_steps=(), metas=()):
+        d = root / name
+        d.mkdir(parents=True)
+        for s in steps:
+            (d / str(s)).mkdir()
+        for s in tmp_steps:
+            (d / f"{s}.orbax-checkpoint-tmp-0").mkdir()
+        for s in metas:
+            (d / f"meta_{s}.json").write_text(json.dumps({"step": s}))
+        return str(d)
+
+    def test_durable_and_sidecar_steps(self, tmp_path):
+        from machine_learning_apache_spark_tpu.train import (
+            checkpoint as ckpt_mod,
+        )
+
+        d = self._fake_rank_dir(
+            tmp_path, "ckpt_r0", steps=(1, 3), tmp_steps=(2,), metas=(3, 1)
+        )
+        # The tmp dir is an UNFINALIZED save (worker killed mid-write):
+        # not durable, and its step must not be offered for restore.
+        assert ckpt_mod.durable_steps_of(d) == {1, 3}
+        assert ckpt_mod.sidecar_steps_of(d) == [3, 1]
+        assert ckpt_mod.durable_steps_of(str(tmp_path / "missing")) == set()
+        assert ckpt_mod.sidecar_steps_of(str(tmp_path / "missing")) == []
+
+    def test_group_durable_step_is_newest_intersection(self, tmp_path):
+        from machine_learning_apache_spark_tpu.train import (
+            checkpoint as ckpt_mod,
+        )
+
+        d0 = self._fake_rank_dir(
+            tmp_path, "ckpt_r0", steps=(2, 4, 6), metas=(2, 4)
+        )
+        d1 = self._fake_rank_dir(tmp_path, "ckpt_r1", steps=(2, 4), metas=())
+        dirs = {0: d0, 1: d1}
+        # Newest common step wins; rank 1 never finalized step 6.
+        assert ckpt_mod.group_durable_step(dirs) == 4
+        # With an authority meta dir, a step whose sidecar survives is
+        # preferred over a newer sidecar-less one.
+        assert ckpt_mod.group_durable_step(dirs, meta_dir=d0) == 4
+        d0_only2 = self._fake_rank_dir(
+            tmp_path, "only2_r0", steps=(2, 4), metas=(2,)
+        )
+        assert ckpt_mod.group_durable_step(
+            {0: d0_only2, 1: d1}, meta_dir=d0_only2
+        ) == 2
+        # Any rank with nothing durable (or a missing dir) vetoes.
+        empty = self._fake_rank_dir(tmp_path, "ckpt_r2", steps=())
+        assert ckpt_mod.group_durable_step({0: d0, 1: empty}) is None
+        assert ckpt_mod.group_durable_step({0: d0, 1: None}) is None
+
+
+class TestGroupAgreement:
+    """restore_latest_valid under the ckpt_r<k> group convention: ranks
+    must restore the SAME step even when their directories hold
+    different (or corrupt) newest steps."""
+
+    def _save_steps(self, directory, steps, seed_base=10):
+        with CheckpointManager(str(directory)) as ck:
+            for s in steps:
+                ck.save(make_state(seed=seed_base + s), step=s)
+
+    def test_agreement_caps_at_slowest_rank(self, tmp_path):
+        self._save_steps(tmp_path / "ckpt_r0", (1, 2))
+        self._save_steps(tmp_path / "ckpt_r1", (1,))  # step 2 never landed
+        with CheckpointManager(str(tmp_path / "ckpt_r0")) as ck:
+            got = ck.restore_latest_valid(make_state())
+        assert got is not None
+        _, step, _ = got
+        assert step == 1  # capped at the group-agreed step, not own latest
+
+    def test_mixed_corruption_restores_one_common_step(self, tmp_path):
+        """Rank 0 holds valid steps {1,2}; rank 1's step 2 is TORN (data
+        corrupted, pointer never advanced past 1 — the crash-mid-save
+        signature). Every rank must independently agree on step 1 and
+        restore bit-identical state."""
+        import shutil
+
+        from machine_learning_apache_spark_tpu.train import (
+            checkpoint as ckpt_mod,
+        )
+
+        self._save_steps(tmp_path / "ckpt_r0", (1, 2))
+        self._save_steps(tmp_path / "ckpt_r1", (1, 2))
+        r1 = tmp_path / "ckpt_r1"
+        shutil.rmtree(r1 / "2" / "default")  # torn payload
+        (r1 / "latest").write_text(json.dumps({"step": 1}))  # pre-crash ptr
+        (r1 / "meta_2.json").unlink()
+
+        results = {}
+        for name in ("ckpt_r0", "ckpt_r1"):
+            with CheckpointManager(str(tmp_path / name)) as ck:
+                results[name] = ck.restore_latest_valid(make_state())
+        assert all(r is not None for r in results.values())
+        steps = {name: r[1] for name, r in results.items()}
+        assert steps == {"ckpt_r0": 1, "ckpt_r1": 1}
+        # Same step, same payload (the steps were saved from the same
+        # seeds): the gang's next collective sees consistent state.
+        assert ckpt_mod.pointed_step_of(str(tmp_path / "ckpt_r0")) == 2
+        for a, b in zip(
+            jax.tree.leaves(results["ckpt_r0"][0].params),
+            jax.tree.leaves(results["ckpt_r1"][0].params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_no_common_step_starts_fresh(self, tmp_path):
+        self._save_steps(tmp_path / "ckpt_r0", (2,))
+        (tmp_path / "ckpt_r1").mkdir()  # peer exists but saved nothing
+        with CheckpointManager(str(tmp_path / "ckpt_r0")) as ck:
+            assert ck.restore_latest_valid(make_state()) is None
+
+    def test_non_group_dir_ignores_siblings(self, tmp_path):
+        """Outside the ckpt_r<k> convention there is no group: a plain
+        directory restores its own newest step."""
+        self._save_steps(tmp_path / "solo", (1, 2))
+        self._save_steps(tmp_path / "ckpt_r1", (1,))
+        with CheckpointManager(str(tmp_path / "solo")) as ck:
+            got = ck.restore_latest_valid(make_state())
+        assert got is not None and got[1] == 2
+
+
+class TestTopologyStampSidecar:
+    def test_every_sidecar_carries_topology(self, tmp_path):
+        """Satellite contract: world_size / mesh / dp_mode stamped in
+        every meta_<step>.json, even when the caller passes its own
+        meta."""
+        with CheckpointManager(str(tmp_path / "t")) as ck:
+            ck.save(make_state(), step=1)
+            ck.save(make_state(seed=1), step=2, meta={"epoch": 1})
+            for s in (1, 2):
+                stamp = ck.read_meta(s).get("topology")
+                assert stamp is not None
+                assert stamp["world_size"] == 1
+                assert stamp["dp_mode"] == "replicated"
+                assert set(stamp) >= {"world_size", "mesh", "dp_mode", "layout"}
+            assert ck.read_meta(2)["epoch"] == 1  # caller meta preserved
+
+    def test_newest_topology_stamp_survives_missing_pointer(self, tmp_path):
+        """A rank torn down before its pointer flushed still has durable
+        stamped sidecars — the stamp lookup must fall back past the
+        pointer to them."""
+        import os
+
+        with CheckpointManager(str(tmp_path / "ckpt_r0")) as ck:
+            ck.save(make_state(), step=3)
+        os.remove(tmp_path / "ckpt_r0" / "latest")
+        with CheckpointManager(str(tmp_path / "ckpt_r0")) as ck:
+            stamp = ck.newest_topology_stamp()
+        assert stamp is not None and stamp["world_size"] == 1
+
+
+class TestBackgroundFlusher:
+    def test_async_save_flushes_pointer_without_next_save(self, tmp_path):
+        """wait=False saves must become pointed/stamped shortly after the
+        async write lands — NOT at the next save — or a rank killed
+        mid-epoch leaves its whole last checkpoint invisible to group
+        agreement."""
+        import time
+
+        from machine_learning_apache_spark_tpu.train import (
+            checkpoint as ckpt_mod,
+        )
+
+        d = tmp_path / "f"
+        ck = CheckpointManager(str(d))
+        try:
+            ck.save(make_state(), step=1, wait=False)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if ckpt_mod.pointed_step_of(str(d)) == 1:
+                    break
+                time.sleep(0.05)
+            # Deliberately no wait()/close()/second save before asserting.
+            assert ckpt_mod.pointed_step_of(str(d)) == 1
+            assert (d / "meta_1.json").exists()
+        finally:
+            ck.close()

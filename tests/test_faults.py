@@ -101,6 +101,45 @@ class TestOneShotSemantics:
         with pytest.raises(FaultInjected):
             faults.maybe_fault("s")
 
+    def test_world_grammar_and_key(self):
+        """The elastic-shrink plan grammar: ``world=`` scopes a fault to
+        one gang size, so a plan like ``...world=8...;...world=7...``
+        kills exactly one rank per topology along the shrink path."""
+        plan = FaultPlan.from_spec(
+            "crash@train_step:world=8,rank=7,step=5;"
+            "crash@train_step:world=7,rank=6,step=7"
+        )
+        s8, s7 = plan.specs
+        assert (s8.world, s8.rank, s8.step) == (8, 7, 5)
+        assert s8.key.endswith("_w8") and s7.key.endswith("_w7")
+        assert s8.key != s7.key  # distinct one-shot markers per topology
+        unscoped = FaultPlan.from_spec("crash@train_step:rank=1").specs[0]
+        assert unscoped.world is None and "_w" not in unscoped.key
+
+    def test_world_scoping(self, monkeypatch):
+        """A world-scoped fault fires only in a gang of that size: the
+        8-rank fault stays dormant after the shrink to 7 even though the
+        rank/step coordinates line up again."""
+        monkeypatch.setenv("MLSPARK_PROCESS_ID", "7")
+        monkeypatch.setenv("MLSPARK_NUM_PROCESSES", "8")
+        faults.install(FaultPlan.from_spec("raise@s:world=7,rank=7"))
+        faults.maybe_fault("s")  # world 8 != 7: no fire
+        monkeypatch.setenv("MLSPARK_NUM_PROCESSES", "7")
+        faults.install(FaultPlan.from_spec("raise@s:world=7,rank=7"))
+        with pytest.raises(FaultInjected):
+            faults.maybe_fault("s")
+
+    def test_shrink_path_plan_matches_one_fault_per_world(self):
+        plan = FaultPlan.from_spec(
+            "crash@t:world=8,rank=7,step=5;crash@t:world=7,rank=6,step=7"
+        )
+        s8, s7 = plan.specs
+        assert s8.matches("t", 7, 5, None, 8) and not s8.matches("t", 7, 5, None, 7)
+        assert s7.matches("t", 6, 7, None, 7) and not s7.matches("t", 6, 7, None, 8)
+        # Unscoped specs keep matching any world (legacy plans unchanged).
+        legacy = FaultPlan.from_spec("crash@t:rank=1").specs[0]
+        assert legacy.matches("t", 1, None, None, 6)
+
     def test_env_plan_loads_lazily(self, monkeypatch):
         monkeypatch.setenv(faults.ENV_PLAN, "raise@lazy_site")
         with pytest.raises(FaultInjected):

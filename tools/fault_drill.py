@@ -1,4 +1,4 @@
-"""Fault drill — run the injection scenarios end to end, emit FAULTS_r02.json.
+"""Fault drill — run the injection scenarios end to end, emit FAULTS_r04.json.
 
 The executable form of docs/FAULT_TOLERANCE.md: each scenario arms a
 deterministic fault plan (``utils.faults``), runs the real subsystem
@@ -20,6 +20,14 @@ against it, and records what the robustness layer did about it:
   surviving replica keeps serving through the outage, the router drains
   around the dead rank, the ``ReplicaGang`` supervisor restarts it, and
   post-recovery traffic reaches it again.
+- ``elastic_shrink`` (round 4) — an 8-rank training gang loses rank 7
+  PERMANENTLY (restart budget 0), shrinks to 7 and elastically resumes
+  from the group-durable checkpoint via cross-topology resharding
+  (``train/reshard.py``), then loses rank 6 of the shrunken gang too and
+  shrinks again to 6. The 6-rank survivor must finish the same global
+  batch schedule (global batch 168 = lcm(8,7,6) keeps per-step batches
+  identical at every world size) within float tolerance of an unfaulted
+  run's final loss.
 
 Round 2 additionally asserts the flight recorder: every drilled failure
 must leave a non-empty ``flight_<rank>.json`` (dumped by ``maybe_fault``
@@ -29,7 +37,7 @@ recorded in the artifact.
 
 Usage::
 
-    python tools/fault_drill.py [--out FAULTS_r03.json] [scenario ...]
+    python tools/fault_drill.py [--out FAULTS_r04.json] [scenario ...]
 
 Exits nonzero if any scenario's invariant does not hold, so CI can gate
 on the drill the way it gates on the test suite.
@@ -380,7 +388,83 @@ def scenario_fleet_kill_replica(workdir: str) -> dict:
     }
 
 
+def scenario_elastic_shrink(workdir: str) -> dict:
+    """Shrink-to-fit resume: 8 ranks -> kill 2 permanently -> finish on 6.
+
+    Restart budget 0 makes both crashes permanent rank losses, so the
+    Distributor's elastic policy is the only path back: each loss tears
+    the gang down and relaunches it one rank smaller, and each smaller
+    gang must reshard the previous topology's per-rank checkpoints onto
+    its own layout before continuing. The second crash is constrained to
+    ``world=7`` so it only arms after the first shrink took effect —
+    drilling two sequential reshards (8-rank layout then 7-rank layout)
+    rather than two concurrent losses.
+
+    Invariants: both faults fire exactly once (marker files), the final
+    gang reports world 6, the resume went through a checkpoint (not a
+    fresh start), the final loss is within float tolerance of an
+    unfaulted run of the same global batch schedule, and each crashed
+    rank left its flight-recorder dump."""
+    from machine_learning_apache_spark_tpu.launcher import Distributor
+
+    t0 = time.monotonic()
+    # Unfaulted reference at the POST-shrink world size: global batch 168
+    # divides every world on the shrink path, so the 6-rank reference runs
+    # the exact global batch schedule the drilled gang must reproduce
+    # (ZeRO-1 needs a >1 data axis, so the reference is a gang too).
+    ref = Distributor(num_processes=6, platform="cpu", timeout=600).run(
+        "launcher_workers:elastic_drill_train",
+        os.path.join(workdir, "ref"),
+        epochs=4, global_batch=168, steps_per_epoch=2,
+    )
+
+    plan = (
+        "crash@train_step:world=8,rank=7,step=5;"
+        "crash@train_step:world=7,rank=6,step=7"
+    )
+    markers = os.path.join(workdir, "markers")
+    tdir = os.path.join(workdir, "telemetry")
+    _with_plan(plan, markers, telemetry_dir=tdir)
+    try:
+        out = Distributor(
+            num_processes=8, platform="cpu", timeout=600,
+            elastic=True, rank_restart_budget=0, elastic_min_world=6,
+            backoff_base=0.05, term_grace=2.0,
+        ).run(
+            "launcher_workers:elastic_drill_train",
+            os.path.join(workdir, "gang"),
+            epochs=4, global_batch=168, steps_per_epoch=2,
+        )
+        flights = {r: _flight_info(tdir, r) for r in (7, 6)}
+    finally:
+        _clear_plan()
+    fired = sorted(os.listdir(markers)) if os.path.isdir(markers) else []
+    loss_delta = abs(out["final_loss"] - ref["final_loss"])
+    return {
+        "scenario": "elastic_shrink",
+        "plan": plan,
+        "fault_fired": fired,
+        "unfaulted_final_loss": ref["final_loss"],
+        "drilled_final_loss": out["final_loss"],
+        "loss_delta": loss_delta,
+        "final_world": out["world"],
+        "resumed_step": out["resumed_step"],
+        "flights": {str(r): f for r, f in flights.items()},
+        "wall_seconds": round(time.monotonic() - t0, 2),
+        "ok": (
+            len(fired) == 2
+            and out["world"] == 6
+            and out["resumed_step"] in (2, 4, 6)
+            and loss_delta < 1e-3
+            and all(
+                f["exists"] and f["events"] > 0 for f in flights.values()
+            )
+        ),
+    }
+
+
 SCENARIOS = {
+    "elastic_shrink": scenario_elastic_shrink,
     "gang_crash_resume": scenario_gang_crash_resume,
     "gang_stall": scenario_gang_stall,
     "serving_poison": scenario_serving_poison,
@@ -390,7 +474,7 @@ SCENARIOS = {
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
-    ap.add_argument("--out", default="FAULTS_r03.json")
+    ap.add_argument("--out", default="FAULTS_r04.json")
     ap.add_argument(
         "scenarios", nargs="*", default=None,
         help=f"subset to run (default: all of {sorted(SCENARIOS)})",
@@ -410,7 +494,7 @@ def main() -> int:
 
     report = {
         "artifact": "FAULTS",
-        "round": 3,
+        "round": 4,
         "all_ok": all(r["ok"] for r in results),
         "scenarios": results,
     }
