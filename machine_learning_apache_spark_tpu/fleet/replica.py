@@ -337,7 +337,13 @@ def serve_replica(
     """Gang-worker body: start engine + data plane, publish the sidecar,
     serve until the driver drops a ``fleet_stop`` marker in the fleet
     dir (or ``max_s`` passes), then drain and report. Importable by
-    reference — the replica-gang launch mode runs exactly this."""
+    reference — the replica-gang launch mode runs exactly this.
+
+    Engine knobs resolve arg > env > default inside ``translator.serve``
+    — so a fleet driver can set a replica's KV discipline either
+    explicitly (``engine_knobs={"kv_mode": ..., "kv_dtype": ...}``) or
+    through the Distributor env contract (``MLSPARK_SERVE_KV_MODE`` /
+    ``MLSPARK_SERVE_KV_DTYPE`` exported to every rank)."""
     d = directory or fleet_dir() or "."
     if port is None:
         port = int(os.environ.get("MLSPARK_FLEET_PORT", "0"))

@@ -317,7 +317,11 @@ class Translator:
         ``kv_mode="padded"`` (or env ``MLSPARK_SERVE_KV_MODE``) selects
         the legacy shape-bucketed rectangle path, which ``method="beam"``
         still requires. Both modes produce outputs identical to
-        ``__call__`` (docs/SERVING.md).
+        ``__call__`` (docs/SERVING.md). ``kv_dtype="int8"`` (or env
+        ``MLSPARK_SERVE_KV_DTYPE``) quantizes the paged KV pages to int8
+        with per-page scales — ~4x the concurrency ceiling per HBM byte
+        at >= 0.99 greedy token agreement; padded/beam engines reject it
+        at construction (their flax cache has no scale plane).
 
         >>> with t.serve(max_batch=8, boundaries=(16, 32)) as eng:
         ...     futs = [eng.submit(s) for s in sentences]

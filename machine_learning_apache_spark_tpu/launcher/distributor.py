@@ -152,6 +152,7 @@ class Distributor:
         dp_mode: str | None = None,
         dp_overlap: bool | None = None,
         serve_kv_mode: str | None = None,
+        serve_kv_dtype: str | None = None,
         telemetry_http: int | None = None,
         ingest: dict | None = None,
         timeout: float = 600.0,
@@ -204,6 +205,19 @@ class Distributor:
                 "'padded' or 'paged')"
             )
         self.serve_kv_mode = serve_kv_mode
+        # Serving KV-store dtype, same contract: the knob becomes
+        # MLSPARK_SERVE_KV_DTYPE in every worker ("float32" is the engine
+        # default; "int8" quantizes paged KV pages with per-page scales).
+        # ServingEngine revalidates against the resolved kv_mode — int8
+        # with a padded/beam engine fails there with the full context.
+        if serve_kv_dtype is not None and serve_kv_dtype not in (
+            "float32", "int8"
+        ):
+            raise ValueError(
+                f"unknown serve_kv_dtype {serve_kv_dtype!r} (expected "
+                "'float32' or 'int8')"
+            )
+        self.serve_kv_dtype = serve_kv_dtype
         # Live observability plane, same env-contract shape: the knob
         # becomes MLSPARK_TELEMETRY_HTTP in every worker, which runner.main
         # resolves into a per-rank HTTP server. 0 means "ephemeral port per
@@ -527,6 +541,8 @@ class Distributor:
             # inherited env; explicit env= still wins below).
             if self.serve_kv_mode is not None:
                 env["MLSPARK_SERVE_KV_MODE"] = self.serve_kv_mode
+            if self.serve_kv_dtype is not None:
+                env["MLSPARK_SERVE_KV_DTYPE"] = self.serve_kv_dtype
             # Observability-plane port knob, same contract shape.
             if self.telemetry_http is not None:
                 env["MLSPARK_TELEMETRY_HTTP"] = str(self.telemetry_http)

@@ -199,6 +199,8 @@ class MultiHeadAttention(nn.Module):
                     q[:, 0].reshape(b, cfg.num_heads, head_dim),
                     paged["k_pages"], paged["v_pages"],
                     paged["table"], paged["length"],
+                    k_scale=paged.get("k_scale"),
+                    v_scale=paged.get("v_scale"),
                 )
             else:
                 # Self-attention: project this step's Q/K/V, attend the
@@ -213,6 +215,8 @@ class MultiHeadAttention(nn.Module):
                     q[:, 0].reshape(b, cfg.num_heads, head_dim),
                     paged["k_pages"], paged["v_pages"],
                     paged["table"], paged["length"],
+                    k_scale=paged.get("k_scale"),
+                    v_scale=paged.get("v_scale"),
                     cur_k=k[:, 0], cur_v=v[:, 0],
                 )
             return out_proj(ctx.reshape(b, 1, cfg.d_model))
@@ -544,6 +548,23 @@ class Decoder(nn.Module):
                         length=paged["mem_len"],
                     ),
                 )
+                # Quantized stores ship per-slot dequantization scales
+                # ([layers, 2, num_pages, page]) alongside the int8
+                # payload; each attention site gets its layer's k/v plane.
+                if paged.get("self_scales") is not None:
+                    layer_kw["paged_self"]["k_scale"] = (
+                        paged["self_scales"][i, 0]
+                    )
+                    layer_kw["paged_self"]["v_scale"] = (
+                        paged["self_scales"][i, 1]
+                    )
+                if paged.get("mem_scales") is not None:
+                    layer_kw["paged_mem"]["k_scale"] = (
+                        paged["mem_scales"][i, 0]
+                    )
+                    layer_kw["paged_mem"]["v_scale"] = (
+                        paged["mem_scales"][i, 1]
+                    )
             if sow_mem_kv:
                 layer_kw["sow_mem_kv"] = True
             y = layer_cls(self.cfg, name=f"layer_{i}")(
@@ -701,6 +722,7 @@ class Transformer(nn.Module):
     def decode_step_paged(
         self, token, self_pages, mem_pages, self_table, self_len,
         mem_table, mem_len, positions,
+        self_scales=None, mem_scales=None,
     ):
         """One ragged decode step over the paged KV stores: ``token`` is
         ``[R, 1]`` (one position per request row); ``self_pages`` and
@@ -714,7 +736,10 @@ class Transformer(nn.Module):
         depths of generation share one program. The step's new
         self-attention K/V are sown into the ``"paged"`` collection
         (``decoder/layer_i/self_attn/k_new|v_new``) for the caller to
-        scatter at each row's cursor."""
+        scatter at each row's cursor. Quantized stores (int8 payload)
+        pass their per-slot dequantization scales as ``self_scales`` /
+        ``mem_scales`` (``[layers, 2, num_pages, page]`` float32);
+        ``None`` means that store is full-precision."""
         y = self.decoder(
             token,
             None,
@@ -729,6 +754,8 @@ class Transformer(nn.Module):
                 self_len=self_len,
                 mem_table=mem_table,
                 mem_len=mem_len,
+                self_scales=self_scales,
+                mem_scales=mem_scales,
             ),
             positions=positions,
             deterministic=True,

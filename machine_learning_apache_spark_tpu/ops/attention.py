@@ -151,6 +151,8 @@ def ragged_paged_attention(
     block_table: jnp.ndarray,
     lengths: jnp.ndarray,
     *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     cur_k: jnp.ndarray | None = None,
     cur_v: jnp.ndarray | None = None,
     use_pallas: bool | None = None,
@@ -167,29 +169,43 @@ def ragged_paged_attention(
 
     - ``query`` — ``[R, H, dh]``, one position per request row;
     - ``k_pages`` / ``v_pages`` — ``[num_pages, page_size, H*dh]``, the
-      shared page store (page 0 is the never-allocated null page);
+      shared page store (page 0 is the never-allocated null page); may
+      be ``int8`` when paired with ``k_scale``/``v_scale``;
     - ``block_table`` — ``[R, P]`` int32 page ids, zero-padded past each
       request's pages;
     - ``lengths`` — ``[R]`` int32 valid cached positions (0 = inactive);
+    - ``k_scale`` / ``v_scale`` — optional ``[num_pages, page_size]``
+      float32 dequantization scales for quantized page stores: slot
+      ``(p, s)`` of the store dequantizes as ``pages[p, s] * scale[p, s]``.
+      Scales ride the same block-table indirection as the pages, so a
+      shared prefix page carries its scale to every reader;
     - ``cur_k`` / ``cur_v`` — optional ``[R, H*dh]``: the current step's
       K/V, attended unconditionally (the causal diagonal) *in addition*
       to the cached positions — this lets the caller run attention and
       the cache scatter in the same fused step without a read-after-write
-      hazard on the page store.
+      hazard on the page store. Always full-precision (never quantized).
 
     Dispatch mirrors ``dot_product_attention``: a Pallas TPU kernel
     whose block tables drive data-dependent page DMA when the layout
-    allows it (``dh % 128 == 0``, ``page_size % 8 == 0``), otherwise a
-    bit-equivalent gather + masked-softmax XLA path (the CPU tier-1
-    route, same fallback discipline as PR 7's native parsers).
+    allows it (``dh % 128 == 0``, ``page_size % 8 == 0`` for fp32 pages
+    or ``page_size % 32 == 0`` for int8 pages — the int8 min-tile
+    sublane count), otherwise a bit-equivalent gather + masked-softmax
+    XLA path (the CPU tier-1 route, same fallback discipline as PR 7's
+    native parsers). Both paths dequantize to float32 *before* the dot
+    products, so kernel and fallback agree to float rounding.
     """
     num_rows, num_heads, head_dim = query.shape
     page_size = k_pages.shape[1]
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
     if use_pallas is None:
+        # int8 pages tile at (32, 128) on TPU, fp32 at (8, 128) — the
+        # page_size divisibility gate follows the store dtype.
+        min_sublanes = 32 if k_pages.dtype == jnp.int8 else 8
         use_pallas = (
             jax.default_backend() == "tpu"
             and head_dim % 128 == 0
-            and page_size % 8 == 0
+            and page_size % min_sublanes == 0
         )
     if use_pallas:
         from machine_learning_apache_spark_tpu.ops.pallas_attention import (
@@ -198,6 +214,7 @@ def ragged_paged_attention(
 
         return ragged_paged_attention_kernel(
             query, k_pages, v_pages, block_table, lengths,
+            k_scale=k_scale, v_scale=v_scale,
             cur_k=cur_k, cur_v=cur_v, interpret=interpret,
         )
     # XLA fallback: gather the block-table pages into a dense [R, W, ...]
@@ -208,6 +225,11 @@ def ragged_paged_attention(
     width = pages_per_req * page_size
     k = jnp.take(k_pages, block_table, axis=0)  # [R, P, page, D]
     v = jnp.take(v_pages, block_table, axis=0)
+    if k_scale is not None:
+        ks = jnp.take(k_scale, block_table, axis=0)  # [R, P, page]
+        vs = jnp.take(v_scale, block_table, axis=0)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     k = k.reshape(num_rows, width, num_heads, head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(num_rows, width, num_heads, head_dim).transpose(0, 2, 1, 3)
     valid = jnp.arange(width)[None, :] < lengths[:, None]  # [R, W]

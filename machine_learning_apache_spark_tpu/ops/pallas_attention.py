@@ -642,6 +642,7 @@ def _ragged_paged_kernel(
     k_ref,
     v_ref,
     *refs,
+    has_scale: bool,
     has_cur: bool,
     num_heads: int,
     heads_padded: int,
@@ -651,6 +652,8 @@ def _ragged_paged_kernel(
     scale: float,
 ):
     refs = list(refs)
+    ks_ref = refs.pop(0) if has_scale else None
+    vs_ref = refs.pop(0) if has_scale else None
     cur_k_ref = refs.pop(0) if has_cur else None
     cur_v_ref = refs.pop(0) if has_cur else None
     o_ref = refs.pop(0)
@@ -720,10 +723,17 @@ def _ragged_paged_kernel(
         k_idx = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1
         )
+        keys, values = k_ref[0], v_ref[0]
+        if has_scale:
+            # Dequantize the page *before* the dots — same order as the
+            # XLA fallback, so kernel and fallback agree to float
+            # rounding. Scales are per page-slot, broadcast over lanes.
+            keys = keys.astype(jnp.float32) * ks_ref[0][:, None]
+            values = values.astype(jnp.float32) * vs_ref[0][:, None]
         _fold(
-            _scores(k_ref[0], page_size),
+            _scores(keys, page_size),
             k_idx < length,
-            v_ref[0],
+            values,
             page_size,
         )
 
@@ -752,6 +762,8 @@ def ragged_paged_attention_kernel(
     block_table: jnp.ndarray,
     lengths: jnp.ndarray,
     *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     cur_k: jnp.ndarray | None = None,
     cur_v: jnp.ndarray | None = None,
     interpret: bool = False,
@@ -760,9 +772,11 @@ def ragged_paged_attention_kernel(
     for the contract). Grid ``(requests, page_steps)`` with the page axis
     sequential so the online-softmax scratch survives a request's sweep;
     K/V operands are one page per step, addressed through the
-    scalar-prefetched block table. On TPU this wants ``dh % 128 == 0``
-    and ``page_size % 8 == 0`` (the dispatcher's gate); interpret mode
-    (CPU tests) takes any shape."""
+    scalar-prefetched block table — and so are the optional per-slot
+    dequantization scales, which ride the *same* ``tbl[r, p]`` index map
+    as their pages. On TPU this wants ``dh % 128 == 0`` and
+    ``page_size % 8 == 0`` (``% 32`` for int8 pages — the dispatcher's
+    gate); interpret mode (CPU tests) takes any shape."""
     num_rows, num_heads, head_dim = query.shape
     page_size, d_model = k_pages.shape[1], k_pages.shape[2]
     pages_per_req = block_table.shape[1]
@@ -792,6 +806,18 @@ def ragged_paged_attention_kernel(
         k_pages,
         v_pages,
     ]
+    if k_scale is not None:
+        operands += [
+            k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)
+        ]
+        in_specs += [
+            pl.BlockSpec(
+                (1, page_size), lambda r, p, tbl, lens: (tbl[r, p], 0)
+            ),
+            pl.BlockSpec(
+                (1, page_size), lambda r, p, tbl, lens: (tbl[r, p], 0)
+            ),
+        ]
     if cur_k is not None:
         operands += [cur_k[:, None, :], cur_v[:, None, :]]
         in_specs += [
@@ -815,6 +841,7 @@ def ragged_paged_attention_kernel(
     out = pl.pallas_call(
         functools.partial(
             _ragged_paged_kernel,
+            has_scale=k_scale is not None,
             has_cur=cur_k is not None,
             num_heads=num_heads,
             heads_padded=heads_padded,

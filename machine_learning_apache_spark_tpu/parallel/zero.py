@@ -47,10 +47,16 @@ of the same rewrite — params keep their TP placement
 vector is sharded over ``(data, model)`` jointly (so moments shrink by
 the full device count), and ``with_sharding_constraint`` pins the
 layouts while XLA's weight-update sharding compiles the
-reduce-scatter/allgather pair and schedules its own overlap. Hybrid is
-fp32-wire only (the implicit path cannot express a compressed wire
-dtype) and trains to parity with a pure-TP + replicated-DP reference
-(tests/test_zero.py pins it).
+reduce-scatter/allgather pair and schedules its own overlap. The
+implicit form cannot *place* the collectives itself, but it can bound
+what crosses the wire: with ``comms_dtype`` bf16/int8 the hybrid step
+quantize-dequantizes each gradient bucket (per-bucket absmax scale for
+int8 — the same EQuARX-style machinery as the explicit path) *before*
+the sharded update, so whatever reduce-scatter XLA schedules moves
+bf16/int8-precision values while master weights, moments, and the
+all-gathered params stay fp32. Gradient parity vs the fp32 wire is
+bounded by the QDQ rounding alone (tests/test_zero.py pins both the
+fp32 equivalence gate and the compressed-wire tolerance).
 
 Shard layout (explicit path): device ``i`` owns the ``i``-th 1/N slice
 of *every bucket* (what ``psum_scatter`` hands it), concatenated. The
@@ -436,13 +442,6 @@ def init_sharded(
     config = config or Zero1Config()
     axis_size, model_ways = _require_zero1_mesh(mesh, config.axis)
     hybrid = model_ways > 1
-    if hybrid and config.comms_dtype != "float32":
-        raise ValueError(
-            "hybrid data x model zero1 runs the implicit sharded-update "
-            "step, which cannot express a compressed wire dtype; got "
-            f"comms_dtype={config.comms_dtype!r} (use 'float32', or a "
-            "pure data mesh for bf16/int8 wire compression)"
-        )
     import flax.linen as nn
 
     if hybrid:
@@ -768,6 +767,19 @@ def _make_hybrid_step(
     allgather sequence (arxiv 2004.13336's original formulation) and
     schedules its own comm/compute overlap.
 
+    ``config.comms_dtype`` bf16/int8 bounds the gradient wire precision
+    at the semantic level: each bucket of the flat gradient is
+    quantize-dequantized (per-bucket absmax scale for int8, plain
+    round-trip for bf16) *before* the sharded update, so the values any
+    XLA-scheduled reduce-scatter moves carry at most the compressed
+    dtype's information, while the fp32 master weights, moments, and the
+    all-gathered params are untouched. Honest caveat: unlike the
+    explicit ``shard_map`` path, this does not force the physical
+    collective to ship 1/2-byte elements — XLA owns the schedule — but
+    the numerics (and therefore training behaviour) match the
+    compressed-wire contract, and ``comms_bytes_per_step`` reports the
+    semantic wire bytes for the telemetry counters.
+
     Step semantics match ``make_train_step`` (one global-batch loss under
     jit; no per-replica rng fold-in), which is exactly what the
     pure-TP + replicated-DP parity reference uses.
@@ -784,6 +796,32 @@ def _make_hybrid_step(
         state.params,
     )
 
+    def _compress_wire(flat_g):
+        """Per-bucket QDQ at the wire dtype. Bucket boundaries are
+        multiples of the full shard count (make_flat_plan is built with
+        ``axis_size * model_ways``), so each segment's QDQ is aligned
+        with the shards the sharded update will move."""
+        if config.comms_dtype == "float32":
+            return flat_g
+        segs = []
+        for s, e in plan.buckets:
+            seg = flat_g[s:e]
+            if config.comms_dtype == "bfloat16":
+                seg = seg.astype(jnp.bfloat16).astype(jnp.float32)
+            else:  # int8, per-bucket absmax scale — no N-way-sum
+                # headroom factor: XLA performs the reduction in fp32
+                # after dequantization, so only the stored values are
+                # bounded to [-127, 127].
+                absmax = jnp.max(jnp.abs(seg))
+                scale = jnp.maximum(absmax / 127.0, jnp.float32(1e-30))
+                seg = (
+                    jnp.clip(jnp.round(seg / scale), -127, 127) * scale
+                )
+            segs.append(seg)
+        return jax.lax.with_sharding_constraint(
+            jnp.concatenate(segs), flat_sharding
+        )
+
     @functools.partial(jax.jit, donate_argnums=0)
     def _step(zstate: Zero1State, batch, rng: jax.Array):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -795,6 +833,7 @@ def _make_hybrid_step(
         flat_g = jax.lax.with_sharding_constraint(
             _flatten(grads, plan, constrain=replicated), flat_sharding
         )
+        flat_g = _compress_wire(flat_g)
         if grad_clip is not None:
             # True global norm (the pad is zeros) — optax
             # clip_by_global_norm semantics, no psum needed under jit.

@@ -87,7 +87,10 @@ class ServingEngine:
     ``max_active`` (concurrent rows), ``page_size``/``num_pages`` (KV
     granularity/budget), ``prefill_chunk``+``prefill_budget`` (chunked-
     prefill pacing), ``steps_per_launch`` (decode steps per dispatch),
-    ``prefix_cache_size`` (shared-prefix entries).
+    ``prefix_cache_size`` (shared-prefix entries), and ``kv_dtype``
+    (``"float32"`` default / ``"int8"`` quantized pages with per-page
+    scales, env ``MLSPARK_SERVE_KV_DTYPE``; paged+greedy only —
+    padded/beam engines reject int8 loudly).
     """
 
     def __init__(
@@ -105,6 +108,8 @@ class ServingEngine:
         beam_size: int = 4,
         length_penalty: float = 0.6,
         kv_mode: str | None = None,
+        kv_dtype: str | None = None,
+        quantize_self: bool = False,
         page_size: int = 8,
         prefill_chunk: int | None = None,
         steps_per_launch: int = 4,
@@ -139,6 +144,27 @@ class ServingEngine:
             # for that yet, so beam engines run the padded path.
             log.info("beam method: routing kv_mode paged -> padded")
             kv_mode = "padded"
+        # Quantized KV store: arg > env > default, validated here like
+        # kv_mode. int8 exists only for the paged store (the padded/beam
+        # flax cache has no scale plane), so those combinations fail
+        # loudly instead of silently serving fp32.
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("MLSPARK_SERVE_KV_DTYPE", "float32")
+        if kv_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'float32' or 'int8', got {kv_dtype!r} "
+                "(check MLSPARK_SERVE_KV_DTYPE)"
+            )
+        if kv_dtype == "int8" and kv_mode != "paged":
+            raise ValueError(
+                "kv_dtype='int8' requires the paged KV store; this engine "
+                f"resolved kv_mode={kv_mode!r}"
+                + (" via method='beam'" if method == "beam" else "")
+                + " — use kv_mode='paged' with greedy decoding, or drop "
+                "the int8 request (check MLSPARK_SERVE_KV_DTYPE)"
+            )
+        self.kv_dtype = kv_dtype
+        self.quantize_self = bool(quantize_self)
         self.translator = translator
         self.boundaries = boundaries
         self.max_batch = max_batch
@@ -188,6 +214,8 @@ class ServingEngine:
                 steps_per_launch=steps_per_launch,
                 num_pages=num_pages,
                 prefix_cache_size=prefix_cache_size,
+                kv_dtype=kv_dtype,
+                quantize_self=quantize_self,
                 sos_id=SOS_ID, eos_id=EOS_ID, pad_id=cfg.pad_id,
             )
             # The row pool: one slot = one cache row in the launch
@@ -274,6 +302,10 @@ class ServingEngine:
             telemetry.register_live_gauge(
                 "serving", "kv_page_occupancy",
                 lambda: self.runtime.mem_pool.occupancy,
+            )
+            telemetry.register_live_gauge(
+                "serving", "kv_mem_bytes_in_use",
+                lambda: self.runtime.mem_pool.bytes_in_use,
             )
             telemetry.register_live_gauge(
                 "serving", "active_rows",
@@ -377,6 +409,7 @@ class ServingEngine:
             "worker_alive": worker_alive,
             "quarantine_recovered": recovered,
             "kv_mode": self.kv_mode,
+            "kv_dtype": self.kv_dtype,
             "queue_depth": self.queue.depth,
             "loop_restarts": self.metrics.loop_restarts,
             "quarantined": self.metrics.quarantined,
@@ -388,6 +421,7 @@ class ServingEngine:
         request exemplars."""
         out = {
             "kv_mode": self.kv_mode,
+            "kv_dtype": self.kv_dtype,
             "method": self.method,
             "boundaries": list(self.boundaries),
             "max_batch": self.max_batch,

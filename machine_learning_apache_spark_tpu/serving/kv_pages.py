@@ -62,13 +62,20 @@ class KVPagePool:
     crash/expiry path needs only the request id.
     """
 
-    def __init__(self, num_pages: int):
+    def __init__(self, num_pages: int, *, page_bytes: int | None = None):
         if num_pages < 2:
             raise ValueError(
                 f"num_pages must be >= 2 (page 0 is reserved), got {num_pages}"
             )
+        if page_bytes is not None and page_bytes < 1:
+            raise ValueError(f"page_bytes must be >= 1, got {page_bytes}")
         self.num_pages = num_pages
         self.capacity = num_pages - 1  # allocatable pages
+        #: Device bytes one page actually costs (payload + any
+        #: quantization scales) — set by the owner so occupancy and
+        #: high-water readings convert honestly to bytes regardless of
+        #: the store dtype. None = owner never told us.
+        self.page_bytes = page_bytes
         self._cond = threading.Condition()
         self._free = list(range(num_pages - 1, 0, -1))  # stack, page 1 on top
         self._refs: dict[int, int] = {}
@@ -180,6 +187,27 @@ class KVPagePool:
     def occupancy(self) -> float:
         """Allocated fraction of the pool, 0.0-1.0."""
         return self.in_use / self.capacity
+
+    @property
+    def bytes_in_use(self) -> int | None:
+        """Actual device bytes of allocated pages — dtype-aware (int8
+        payload + scale planes count what they really cost), or None
+        when the owner never declared ``page_bytes``."""
+        return None if self.page_bytes is None else (
+            self.in_use * self.page_bytes
+        )
+
+    @property
+    def bytes_high_water(self) -> int | None:
+        return None if self.page_bytes is None else (
+            self.high_water * self.page_bytes
+        )
+
+    @property
+    def bytes_capacity(self) -> int | None:
+        return None if self.page_bytes is None else (
+            self.capacity * self.page_bytes
+        )
 
     def refcount(self, page: int) -> int:
         with self._cond:
@@ -301,6 +329,9 @@ class PrefixCache:
             digests = [
                 e["digest"] for e in reversed(self._entries.values())
             ][:max_digests]
+            resident_pages = sum(
+                len(e["pages"]) for e in self._entries.values()
+            )
             return {
                 "entries": len(self._entries),
                 "capacity": self.capacity,
@@ -308,6 +339,11 @@ class PrefixCache:
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "hit_rate": round(self.hits / lookups, 4) if lookups else None,
+                "resident_pages": resident_pages,
+                "resident_bytes": (
+                    None if self.pool.page_bytes is None
+                    else resident_pages * self.pool.page_bytes
+                ),
                 "resident_digests": digests,
                 "digests_truncated": max(0, len(self._entries) - len(digests)),
             }

@@ -15,6 +15,18 @@ device, each ``[layers, 2, num_pages, page_size, d_model]``:
   the launch program never carries or copies it — the cache can grow
   large without taxing the decode loop.
 
+With ``kv_dtype="int8"`` the mem store's payload is int8 with per-page
+absmax scales (EQuARX-style, arxiv 2506.17615) kept in a parallel
+``[layers, 2, num_pages, page_size]`` float32 plane addressed by the
+same block tables — 4 fp32 bytes shrink to 1 int8 byte + 4/d_model
+scale bytes per element, so the same HBM budget holds ~4x the pages
+and the pages-limited max-concurrency ceiling rises with it. The
+attention kernel dequantizes per gathered page before its dots
+(``ops.attention.ragged_paged_attention``); scales travel with their
+pages through PrefixCache hits/evictions because they live at the same
+page index. The SELF store can follow via ``quantize_self=True``
+(per-slot scales, written by the decode scatter).
+
 Exactly two kinds of compiled program run over them:
 
 - **prefill** (one per chunk count): encode a prompt padded to the next
@@ -110,6 +122,8 @@ class PagedDecodeRuntime:
         steps_per_launch: int = 4,
         num_pages: int | None = None,
         prefix_cache_size: int = 32,
+        kv_dtype: str = "float32",
+        quantize_self: bool = False,
         sos_id: int,
         eos_id: int,
         pad_id: int,
@@ -127,6 +141,11 @@ class PagedDecodeRuntime:
                 f"max_new_tokens {max_new_tokens} exceeds max_len "
                 f"{cfg.max_len}: decode positions would have no encoding"
             )
+        if kv_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r} (expected 'float32' or "
+                "'int8')"
+            )
         self.model = model
         self.params = params
         self.max_active = max_active
@@ -135,6 +154,17 @@ class PagedDecodeRuntime:
         self.prefill_chunk = prefill_chunk
         self.steps_per_launch = steps_per_launch
         self.sos_id, self.eos_id, self.pad_id = sos_id, eos_id, pad_id
+        # Quantized memory plane (EQuARX-style per-page absmax int8,
+        # arxiv 2506.17615): the MEM store quantizes first — it dominates
+        # footprint (prompt cross-KV + prefix-cache residents) and is
+        # read-only during decode, so it quantizes once at prefill. The
+        # small SELF scan-carry store follows only on request
+        # (``quantize_self``): its per-step scatter must also write
+        # per-slot scales, and the capacity win is marginal.
+        self.kv_dtype = kv_dtype
+        self.quantize_self = bool(quantize_self)
+        self._mem_quant = kv_dtype == "int8"
+        self._self_quant = self._mem_quant and self.quantize_self
 
         # Geometry: self pages cover the max_new_tokens budget; memory
         # pages cover the largest chunk-padded prompt. The self store is
@@ -157,10 +187,6 @@ class PagedDecodeRuntime:
                 f"({self.mem_pages} pages + the reserved null page)"
             )
         self.num_pages = num_pages
-        self.self_pool = KVPagePool(self.num_self_pages)
-        self.mem_pool = KVPagePool(num_pages)
-        self.prefix_cache = PrefixCache(self.mem_pool, prefix_cache_size)
-        self.prefix_cache_size = prefix_cache_size
 
         self._self_shape = (
             cfg.num_layers, 2, self.num_self_pages, page_size, cfg.d_model
@@ -168,9 +194,51 @@ class PagedDecodeRuntime:
         self._mem_shape = (
             cfg.num_layers, 2, num_pages, page_size, cfg.d_model
         )
-        self._store_dtype = cfg.dtype
-        self.kv_self = jnp.zeros(self._self_shape, self._store_dtype)
-        self.kv_mem = jnp.zeros(self._mem_shape, self._store_dtype)
+        self._self_store_dtype = (
+            jnp.int8 if self._self_quant else cfg.dtype
+        )
+        self._mem_store_dtype = jnp.int8 if self._mem_quant else cfg.dtype
+        # Per-slot dequantization scales, same block-table addressing as
+        # the payload: slot (p, s) dequantizes as pages[p, s] * scale[p, s].
+        # MEM scales are per *page* (one absmax per page, broadcast over
+        # its slots); SELF scales are per slot (each decode step scatters
+        # one position, so rescaling the whole page would corrupt the
+        # int8 already written).
+        self._self_scale_shape = (
+            cfg.num_layers, 2, self.num_self_pages, page_size
+        )
+        self._mem_scale_shape = (cfg.num_layers, 2, num_pages, page_size)
+        self.kv_self = jnp.zeros(self._self_shape, self._self_store_dtype)
+        self.kv_mem = jnp.zeros(self._mem_shape, self._mem_store_dtype)
+        self.self_scale = (
+            jnp.zeros(self._self_scale_shape, jnp.float32)
+            if self._self_quant else None
+        )
+        self.mem_scale = (
+            jnp.zeros(self._mem_scale_shape, jnp.float32)
+            if self._mem_quant else None
+        )
+
+        # Dtype-aware byte accounting: a page costs its payload plus (for
+        # quantized stores) one fp32 scale per slot, across every layer's
+        # k and v planes.
+        d = cfg.d_model
+        self.mem_page_bytes = cfg.num_layers * 2 * page_size * (
+            d * np.dtype(self._mem_store_dtype).itemsize
+            + (4 if self._mem_quant else 0)
+        )
+        self.self_page_bytes = cfg.num_layers * 2 * page_size * (
+            d * np.dtype(self._self_store_dtype).itemsize
+            + (4 if self._self_quant else 0)
+        )
+        self.self_pool = KVPagePool(
+            self.num_self_pages, page_bytes=self.self_page_bytes
+        )
+        self.mem_pool = KVPagePool(
+            num_pages, page_bytes=self.mem_page_bytes
+        )
+        self.prefix_cache = PrefixCache(self.mem_pool, prefix_cache_size)
+        self.prefix_cache_size = prefix_cache_size
 
         # Donation lets each program write the store in place; CPU jax
         # does not implement it, so gate to keep the logs clean there.
@@ -181,6 +249,18 @@ class PagedDecodeRuntime:
         self._launch_fn = self._make_launch()
 
         self._reset_host_state()
+
+    def _zero_stores(self) -> None:
+        """Fresh zero payload + scale arrays — identical shapes/dtypes to
+        the live ones, so compiled programs stay valid."""
+        self.kv_self = jnp.zeros(self._self_shape, self._self_store_dtype)
+        self.kv_mem = jnp.zeros(self._mem_shape, self._mem_store_dtype)
+        if self._self_quant:
+            self.self_scale = jnp.zeros(
+                self._self_scale_shape, jnp.float32
+            )
+        if self._mem_quant:
+            self.mem_scale = jnp.zeros(self._mem_scale_shape, jnp.float32)
 
     def _reset_host_state(self) -> None:
         R, Ps, Pm = self.max_active, self.self_pages, self.mem_pages
@@ -202,8 +282,9 @@ class PagedDecodeRuntime:
         width = chunks * self.prefill_chunk
         n_pages = width // self.page_size
         page, d = self.page_size, model.cfg.d_model
+        mem_quant = self._mem_quant
 
-        def fn(params, kv_mem, src, mem_table):
+        def project(params, src):
             _, var = model.apply(
                 {"params": params}, src,
                 method="prefill_paged", mutable=["paged"],
@@ -218,12 +299,38 @@ class PagedDecodeRuntime:
                 for i in range(layers)
             ])
             kv = jnp.stack([k, v], axis=1)  # [L, 2, width, d]
-            kv = kv.reshape(layers, 2, n_pages, page, d)
-            return kv_mem.at[:, :, mem_table].set(
-                kv.astype(kv_mem.dtype)
-            )
+            return kv.reshape(layers, 2, n_pages, page, d)
 
-        donate = (1,) if self._donate else ()
+        if not mem_quant:
+            def fn(params, kv_mem, src, mem_table):
+                kv = project(params, src)
+                return kv_mem.at[:, :, mem_table].set(
+                    kv.astype(kv_mem.dtype)
+                )
+
+            donate = (1,) if self._donate else ()
+            return jax.jit(fn, donate_argnums=donate)
+
+        def fn(params, kv_mem, mem_scale, src, mem_table):
+            kv = project(params, src)
+            # Per-page absmax quantization (the zero1 comms scheme, minus
+            # the N-way-sum headroom — pages are never summed): one scale
+            # per (layer, k/v, page), broadcast to the page's slots so
+            # the kernel's per-slot dequant addressing stays uniform
+            # between the MEM and SELF stores.
+            absmax = jnp.max(jnp.abs(kv), axis=(3, 4))  # [L, 2, n_pages]
+            s = jnp.maximum(absmax / 127.0, jnp.float32(1e-30))
+            q = jnp.clip(
+                jnp.round(kv / s[..., None, None]), -127, 127
+            ).astype(jnp.int8)
+            kv_mem = kv_mem.at[:, :, mem_table].set(q)
+            slot_s = jnp.broadcast_to(
+                s[..., None], (layers, 2, n_pages, page)
+            )
+            mem_scale = mem_scale.at[:, :, mem_table].set(slot_s)
+            return kv_mem, mem_scale
+
+        donate = (1, 2) if self._donate else ()
         return jax.jit(fn, donate_argnums=donate)
 
     def _make_launch(self):
@@ -232,17 +339,22 @@ class PagedDecodeRuntime:
         page, Ps = self.page_size, self.self_pages
         T, mnt = self.steps_per_launch, self.max_new_tokens
         eos, pad = self.eos_id, self.pad_id
+        self_quant = self._self_quant
 
         def fn(params, kv_self, kv_mem, token, cursor, finished,
-               self_tbl, mem_tbl, mem_len):
-            # Only the self store rides the scan carry: the mem store is
-            # read-only during decode, so it enters as a closed-over
-            # operand and is never copied per step.
+               self_tbl, mem_tbl, mem_len, self_scale, mem_scale):
+            # Only the self store (and, when self-quantized, its scale
+            # plane) rides the scan carry: the mem store and its scales
+            # are read-only during decode, so they enter as closed-over
+            # operands and are never copied per step. For fp32 stores the
+            # scale arguments are None — an empty pytree, so the compiled
+            # program is unchanged from the unquantized build.
             def step(carry, _):
-                kv_self, token, cursor, finished = carry
+                kv_self, self_scale, token, cursor, finished = carry
                 logits, var = model.apply(
                     {"params": params}, token[:, None], kv_self, kv_mem,
                     self_tbl, cursor, mem_tbl, mem_len, cursor[:, None],
+                    self_scale, mem_scale,
                     method="decode_step_paged", mutable=["paged"],
                 )
                 sown = var["paged"]["decoder"]
@@ -263,9 +375,25 @@ class PagedDecodeRuntime:
                 )[:, 0]
                 pids = jnp.where(finished, NULL_PAGE, pids)
                 offs = cursor % page
-                kv_self = kv_self.at[:, :, pids, offs, :].set(
-                    knv.astype(kv_self.dtype)
-                )
+                if self_quant:
+                    # Per-slot quantization: this step writes exactly one
+                    # slot per row, so its scale lands next to it — the
+                    # int8 already on the page keeps its own scales.
+                    absmax = jnp.max(jnp.abs(knv), axis=-1)  # [L, 2, R]
+                    s = jnp.maximum(
+                        absmax / 127.0, jnp.float32(1e-30)
+                    )
+                    q = jnp.clip(
+                        jnp.round(knv / s[..., None]), -127, 127
+                    )
+                    kv_self = kv_self.at[:, :, pids, offs, :].set(
+                        q.astype(kv_self.dtype)
+                    )
+                    self_scale = self_scale.at[:, :, pids, offs].set(s)
+                else:
+                    kv_self = kv_self.at[:, :, pids, offs, :].set(
+                        knv.astype(kv_self.dtype)
+                    )
                 emit = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
                 emit = jnp.where(finished, pad, emit)
                 cursor = cursor + jnp.where(finished, 0, 1).astype(jnp.int32)
@@ -275,15 +403,18 @@ class PagedDecodeRuntime:
                     | (emit == pad)
                     | (cursor >= mnt)
                 )
-                return (kv_self, emit, cursor, finished), emit
+                return (kv_self, self_scale, emit, cursor, finished), emit
 
             carry, emits = jax.lax.scan(
-                step, (kv_self, token, cursor, finished), None, length=T
+                step,
+                (kv_self, self_scale, token, cursor, finished),
+                None,
+                length=T,
             )
-            kv_self, token, cursor, finished = carry
-            return kv_self, token, cursor, finished, emits
+            kv_self, self_scale, token, cursor, finished = carry
+            return kv_self, self_scale, token, cursor, finished, emits
 
-        donate = (1,) if self._donate else ()
+        donate = ((1, 9) if self_quant else (1,)) if self._donate else ()
         return jax.jit(fn, donate_argnums=donate)
 
     def jit_fns(self) -> list:
@@ -300,18 +431,24 @@ class PagedDecodeRuntime:
             src = np.full((1, width), self.pad_id, np.int32)
             src[0, : len(seed)] = seed
             tbl = np.full(width // self.page_size, NULL_PAGE, np.int32)
-            self.kv_mem = fn(self.params, self.kv_mem, src, tbl)
+            if self._mem_quant:
+                self.kv_mem, self.mem_scale = fn(
+                    self.params, self.kv_mem, self.mem_scale, src, tbl
+                )
+            else:
+                self.kv_mem = fn(self.params, self.kv_mem, src, tbl)
         out = self._launch_fn(
             self.params, self.kv_self, self.kv_mem, self._token,
             self._cursor, self._finished, self._self_tbl, self._mem_tbl,
-            self._mem_len,
+            self._mem_len, self.self_scale, self.mem_scale,
         )
         self.kv_self = out[0]
+        if self._self_quant:
+            self.self_scale = out[1]
         jax.block_until_ready(self.kv_self)
         # Warmup scribbled on the null pages; reset the stores for
         # hygiene (same shapes and dtypes, so no recompile).
-        self.kv_self = jnp.zeros(self._self_shape, self._store_dtype)
-        self.kv_mem = jnp.zeros(self._mem_shape, self._store_dtype)
+        self._zero_stores()
         return len(self._prefill_fns) + 1
 
     # -- admission -----------------------------------------------------------
@@ -343,10 +480,17 @@ class PagedDecodeRuntime:
                 return None
             src = np.full((1, width), self.pad_id, np.int32)
             src[0, : len(ids)] = ids
-            self.kv_mem = self._prefill_fns[width // self.prefill_chunk](
-                self.params, self.kv_mem, src,
-                np.asarray(pages, np.int32),
-            )
+            fn = self._prefill_fns[width // self.prefill_chunk]
+            if self._mem_quant:
+                self.kv_mem, self.mem_scale = fn(
+                    self.params, self.kv_mem, self.mem_scale, src,
+                    np.asarray(pages, np.int32),
+                )
+            else:
+                self.kv_mem = fn(
+                    self.params, self.kv_mem, src,
+                    np.asarray(pages, np.int32),
+                )
             self.prefix_cache.put(key, pages, n_pages=n_mem,
                                   src_len=len(ids))
             kind, computed = "miss", width
@@ -415,15 +559,17 @@ class PagedDecodeRuntime:
         out = self._launch_fn(
             self.params, self.kv_self, self.kv_mem, self._token,
             self._cursor, self._finished, self._self_tbl, self._mem_tbl,
-            self._mem_len,
+            self._mem_len, self.self_scale, self.mem_scale,
         )
         self.kv_self = out[0]
-        emits = np.asarray(jax.block_until_ready(out[4]))
+        if self._self_quant:
+            self.self_scale = out[1]
+        emits = np.asarray(jax.block_until_ready(out[5]))
         # np.array (copy): host state is mutated by admit/retire, and a
         # bare asarray view of a jax buffer is read-only.
-        self._token = np.array(out[1])
-        self._cursor = np.array(out[2])
-        self._finished = np.array(out[3])
+        self._token = np.array(out[2])
+        self._cursor = np.array(out[3])
+        self._finished = np.array(out[4])
         completed, first_emits, real = [], [], 0
         for r in range(self.max_active):
             req = self._req_of_row[r]
@@ -484,12 +630,15 @@ class PagedDecodeRuntime:
         fails them). Fresh zero store keeps the compiled programs valid
         (same shapes), so recovery costs zero recompiles."""
         active = self.active_requests()
-        self.self_pool = KVPagePool(self.num_self_pages)
-        self.mem_pool = KVPagePool(self.num_pages)
+        self.self_pool = KVPagePool(
+            self.num_self_pages, page_bytes=self.self_page_bytes
+        )
+        self.mem_pool = KVPagePool(
+            self.num_pages, page_bytes=self.mem_page_bytes
+        )
         self.prefix_cache = PrefixCache(self.mem_pool, self.prefix_cache_size)
         self._reset_host_state()
-        self.kv_self = jnp.zeros(self._self_shape, self._store_dtype)
-        self.kv_mem = jnp.zeros(self._mem_shape, self._store_dtype)
+        self._zero_stores()
         return active
 
     # -- introspection -------------------------------------------------------
@@ -498,12 +647,22 @@ class PagedDecodeRuntime:
             "num_pages": self.num_pages,
             "num_self_pages": self.num_self_pages,
             "page_size": self.page_size,
+            "kv_dtype": self.kv_dtype,
+            "quantize_self": self.quantize_self,
+            "mem_page_bytes": self.mem_page_bytes,
+            "self_page_bytes": self.self_page_bytes,
             "mem_pages_in_use": self.mem_pool.in_use,
             "self_pages_in_use": self.self_pool.in_use,
             "mem_occupancy": round(self.mem_pool.occupancy, 4),
             "self_occupancy": round(self.self_pool.occupancy, 4),
             "mem_high_water": self.mem_pool.high_water,
             "self_high_water": self.self_pool.high_water,
+            "mem_bytes_in_use": self.mem_pool.bytes_in_use,
+            "self_bytes_in_use": self.self_pool.bytes_in_use,
+            "mem_bytes_high_water": self.mem_pool.bytes_high_water,
+            "self_bytes_high_water": self.self_pool.bytes_high_water,
+            "mem_bytes_capacity": self.mem_pool.bytes_capacity,
+            "self_bytes_capacity": self.self_pool.bytes_capacity,
             "prefix_cache": self.prefix_cache.stats(),
             "active_rows": self.active_count(),
         }

@@ -548,3 +548,100 @@ class TestRaggedPagedAttention:
         np.testing.assert_allclose(
             np.asarray(out[1]), np.asarray(out[4]), atol=1e-6
         )
+
+    # -- quantized (int8) pages ----------------------------------------------
+
+    def _quantize_pages(self, pages):
+        """Per-page absmax int8 quantization, per-slot scale layout —
+        the same scheme the paged runtime writes: one scale per page,
+        broadcast to every slot so the kernel's [page_size] scale row
+        dequantizes either granularity."""
+        pages = np.asarray(pages)
+        absmax = np.abs(pages).max(axis=(1, 2))
+        scale = np.maximum(absmax / 127.0, 1e-30).astype(np.float32)
+        q = np.clip(
+            np.round(pages / scale[:, None, None]), -127, 127
+        ).astype(np.int8)
+        slot_scale = np.broadcast_to(
+            scale[:, None], pages.shape[:2]
+        ).astype(np.float32)
+        return jnp.asarray(q), jnp.asarray(np.ascontiguousarray(slot_scale))
+
+    def test_int8_quantization_round_trip_bound(self):
+        """Dequantized int8 pages sit within half a quantization step
+        (absmax/254) of the fp32 original — the error budget every
+        downstream accuracy claim rests on."""
+        _, kp, _, _, _, _, _ = self._setup()
+        qk, ks = self._quantize_pages(kp)
+        deq = np.asarray(qk, np.float32) * np.asarray(ks)[..., None]
+        err = np.abs(deq - np.asarray(kp))
+        step = np.abs(np.asarray(kp)).max(axis=(1, 2)) / 127.0
+        assert (err <= step[:, None, None] * 0.5 + 1e-7).all()
+
+    def test_int8_scales_must_come_in_pairs(self):
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            ragged_paged_attention,
+        )
+
+        q, kp, vp, tbl, lens, _, _ = self._setup()
+        qk, ks = self._quantize_pages(kp)
+        qv, _ = self._quantize_pages(vp)
+        with pytest.raises(ValueError, match="k_scale and v_scale"):
+            ragged_paged_attention(
+                q, qk, qv, tbl, lens, k_scale=ks, use_pallas=False
+            )
+
+    @pytest.mark.parametrize("with_cur", [True, False])
+    def test_int8_fallback_matches_dequantized_reference(self, with_cur):
+        """int8 pages + per-slot scales through the fallback must equal
+        the dense reference run on the dequantized fp32 pages — in-
+        kernel dequantization is positioned before the dots, so the two
+        orderings agree to float rounding."""
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            ragged_paged_attention,
+        )
+
+        q, kp, vp, tbl, lens, ck, cv = self._setup(seed=2)
+        if not with_cur:
+            ck = cv = None
+        qk, ks = self._quantize_pages(kp)
+        qv, vs = self._quantize_pages(vp)
+        got = ragged_paged_attention(
+            q, qk, qv, tbl, lens, cur_k=ck, cur_v=cv,
+            k_scale=ks, v_scale=vs, use_pallas=False,
+        )
+        deq_k = jnp.asarray(
+            np.asarray(qk, np.float32) * np.asarray(ks)[..., None]
+        )
+        deq_v = jnp.asarray(
+            np.asarray(qv, np.float32) * np.asarray(vs)[..., None]
+        )
+        want = self._dense_reference(q, deq_k, deq_v, tbl, lens, ck, cv)
+        np.testing.assert_allclose(np.asarray(got), want, atol=2e-5)
+
+    @pytest.mark.parametrize("with_cur", [True, False])
+    def test_int8_kernel_interpret_matches_fallback(self, with_cur):
+        """The Pallas kernel's in-kernel dequant (interpret mode) and
+        the XLA fallback's gather-then-dequant are the same function on
+        int8 pages — extending the CPU-stands-in-for-TPU contract to
+        the quantized plane."""
+        from machine_learning_apache_spark_tpu.ops.attention import (
+            ragged_paged_attention,
+        )
+
+        q, kp, vp, tbl, lens, ck, cv = self._setup(seed=3)
+        if not with_cur:
+            ck = cv = None
+        qk, ks = self._quantize_pages(kp)
+        qv, vs = self._quantize_pages(vp)
+        fb = ragged_paged_attention(
+            q, qk, qv, tbl, lens, cur_k=ck, cur_v=cv,
+            k_scale=ks, v_scale=vs, use_pallas=False,
+        )
+        kern = ragged_paged_attention(
+            q, qk, qv, tbl, lens, cur_k=ck, cur_v=cv,
+            k_scale=ks, v_scale=vs, use_pallas=True, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(kern), np.asarray(fb), atol=2e-5
+        )

@@ -497,6 +497,30 @@ class TestKVPagePool:
         pool.try_acquire(3, "hold")
         assert pool.acquire(1, "b", timeout=0.01) is None  # times out
 
+    def test_byte_accounting_tracks_dtype_page_cost(self):
+        """With ``page_bytes`` set (the runtime passes its dtype-aware
+        per-page cost, scale planes included) the pool reports live and
+        high-water byte figures; without it the byte gauges stay None
+        rather than lying."""
+        from machine_learning_apache_spark_tpu.serving import KVPagePool
+
+        pool = KVPagePool(8, page_bytes=576)
+        assert pool.page_bytes == 576
+        assert pool.bytes_capacity == 7 * 576
+        pool.try_acquire(3, "a")
+        assert pool.bytes_in_use == 3 * 576
+        assert pool.bytes_high_water == 3 * 576
+        pool.release_owner("a")
+        assert pool.bytes_in_use == 0
+        assert pool.bytes_high_water == 3 * 576  # high-water sticks
+        with pytest.raises(ValueError, match="page_bytes"):
+            KVPagePool(8, page_bytes=0)
+        bare = KVPagePool(8)
+        assert bare.page_bytes is None
+        assert bare.bytes_in_use is None
+        assert bare.bytes_high_water is None
+        assert bare.bytes_capacity is None
+
 
 class TestPrefixCache:
     def _mk(self, num_pages=16, capacity=4):
@@ -563,6 +587,31 @@ class TestPrefixCache:
         assert cache.put(("a",), pages) is False
         pool.release_owner("r")
         assert pool.in_use == 0  # no silent cache ref was taken
+
+    def test_stats_reports_resident_footprint(self):
+        """The cache's stats carry its page/byte footprint — the number
+        the capacity-planning gauges scrape — priced at the pool's
+        dtype-aware page cost when one was declared."""
+        from machine_learning_apache_spark_tpu.serving import (
+            KVPagePool,
+            PrefixCache,
+        )
+
+        pool = KVPagePool(16, page_bytes=2048)
+        cache = PrefixCache(pool, 4)
+        for key in ("a", "b"):
+            pages = pool.try_acquire(2, key)
+            cache.put((key,), pages)
+            pool.release_owner(key)
+        st = cache.stats()
+        assert st["resident_pages"] == 4
+        assert st["resident_bytes"] == 4 * 2048
+        pool2, cache2 = self._mk()  # no page_bytes: pages count, bytes None
+        pages = pool2.try_acquire(1, "x")
+        cache2.put(("x",), pages)
+        pool2.release_owner("x")
+        assert cache2.stats()["resident_pages"] == 1
+        assert cache2.stats()["resident_bytes"] is None
 
     def test_contains_is_side_effect_free(self):
         pool, cache = self._mk()
@@ -748,6 +797,91 @@ class TestPagedEngine:
         for wave, outs in expect:
             assert outs == t(wave, max_new_tokens=8)
 
+    def test_kv_dtype_validation_and_env_override(self, tiny_translator):
+        t, _ = tiny_translator
+        import os
+
+        with pytest.raises(ValueError, match="kv_dtype"):
+            t.serve(boundaries=(8,), max_batch=2, kv_dtype="int4",
+                    start=False)
+        # int8 needs the paged store: padded mode and beam (which forces
+        # padded) both reject at construction, naming the resolution.
+        with pytest.raises(ValueError, match="requires the paged"):
+            t.serve(boundaries=(8,), max_batch=2, kv_mode="padded",
+                    kv_dtype="int8", start=False)
+        with pytest.raises(ValueError, match="method='beam'"):
+            t.serve(boundaries=(8,), max_batch=2, method="beam",
+                    beam_size=2, kv_dtype="int8", start=False)
+        os.environ["MLSPARK_SERVE_KV_DTYPE"] = "int8"
+        try:
+            eng = t.serve(boundaries=(8,), max_batch=2, start=False)
+            assert eng.kv_dtype == "int8"
+            assert eng.runtime.stats()["kv_dtype"] == "int8"
+            # explicit argument beats the env contract
+            eng = t.serve(boundaries=(8,), max_batch=2,
+                          kv_dtype="float32", start=False)
+            assert eng.kv_dtype == "float32"
+        finally:
+            del os.environ["MLSPARK_SERVE_KV_DTYPE"]
+
+    def test_int8_engine_zero_recompiles_and_tracks_fp32(
+        self, tiny_translator
+    ):
+        """The quantized plane rides the same compiled programs: after
+        warmup an int8 engine (both stores quantized) serves every wave
+        shape — including prefix-cache hits, whose scales must travel
+        with the shared pages — with zero recompiles, honest dtype-aware
+        byte accounting, and near-oracle greedy outputs."""
+        t, texts = tiny_translator
+        short = [s for s in texts if len(s.split()) <= 5]
+        long_ = [s for s in texts if len(s.split()) >= 7]
+        waves = [
+            short[:1],                  # single row
+            long_[:3],                  # partial, long prompts
+            short[:2] + long_[3:5],     # full, mixed lengths
+            short[:1],                  # repeat: prefix-cache hit
+        ]
+        with t.serve(
+            boundaries=(8, 16), max_batch=4, max_wait_s=0.01,
+            max_new_tokens=8, kv_mode="paged", kv_dtype="int8",
+            quantize_self=True,
+        ) as eng:
+            got = []
+            for wave in waves:
+                outs = [f.result(timeout=120) for f in
+                        [eng.submit(s) for s in wave]]
+                got.append((wave, outs))
+            assert eng.recompiles_after_warmup == 0
+            stats = eng.runtime.stats()
+            assert stats["kv_dtype"] == "int8"
+            assert stats["quantize_self"] is True
+            assert stats["prefix_cache"]["hits"] >= 1
+            # int8 page + fp32 scale per slot < fp32 page
+            fp32_eng = t.serve(boundaries=(8, 16), max_batch=4,
+                               start=False)
+            fp32_page = fp32_eng.runtime.stats()["mem_page_bytes"]
+            assert stats["mem_page_bytes"] < fp32_page / 2
+            assert stats["mem_bytes_high_water"] > 0
+            eng.metrics.check_conservation(in_flight=0)
+        # Scale travel: the cache-hit repeat of wave 0 decoded from
+        # shared pages + shared scales — byte-identical outputs.
+        assert got[3][1] == got[0][1]
+        # Accuracy oracle: greedy token agreement with the fp32 path.
+        matched = total = 0
+        for wave, outs in got:
+            oracle = t(wave, max_new_tokens=8)
+            for a, b in zip(oracle, outs):
+                ta = t.trg_pipe.ragged([a])[0]
+                tb = t.trg_pipe.ragged([b])[0]
+                agree = 0
+                for x, y in zip(ta, tb):
+                    if x != y:
+                        break
+                    agree += 1
+                matched += agree
+                total += max(len(ta), len(tb))
+        assert total > 0 and matched / total >= 0.9
+
     def test_paged_pages_freed_on_completion(self, tiny_translator):
         t, texts = tiny_translator
         with t.serve(
@@ -764,8 +898,9 @@ class TestPagedEngine:
 
 def test_serve_bench_smoke_subprocess(tmp_path):
     """tools/serve_bench.py --smoke is the tier-1 CI entry: fresh
-    process, padded-vs-paged parity gate, and a short paged sweep with
-    the zero-recompile and conservation gates."""
+    process, padded-vs-paged parity gate, the int8 accuracy (token
+    match) and capacity (equal-byte ceiling) gates, and short paged +
+    paged-int8 sweeps with the zero-recompile and conservation gates."""
     import json
     import os
     import subprocess
@@ -779,7 +914,7 @@ def test_serve_bench_smoke_subprocess(tmp_path):
             os.path.join(repo_root, "tools", "serve_bench.py"),
             "--smoke", "--out", str(out),
         ],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=480,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert r.returncode == 0, r.stderr[-2000:]
@@ -787,11 +922,20 @@ def test_serve_bench_smoke_subprocess(tmp_path):
     assert art["ok"] is True
     assert art["gates"] == {
         "parity": True,
+        "token_match": True,
+        "int8_ceiling": True,
         "zero_recompiles": True,
         "conservation": True,
         "midload_scrape": True,
     }
     assert art["parity"]["identical"] is True
+    assert art["token_match"]["token_match_rate"] >= 0.99
+    ceiling = art["concurrency_ceiling"]
+    assert ceiling["int8_ceiling_vs_fp32"] >= 2.0
+    assert (
+        ceiling["int8"]["bytes_per_resident_seq"]
+        < ceiling["float32"]["bytes_per_resident_seq"]
+    )
     scrape = art["modes"]["paged"]["midload_scrape"]
     assert scrape["ok"] is True
     assert 0 <= scrape["in_flight"] <= scrape["in_flight_cap"]
@@ -801,6 +945,12 @@ def test_serve_bench_smoke_subprocess(tmp_path):
     summary = art["modes"]["paged"]["engine_summary"]
     assert summary["padding_waste"] is not None
     assert art["modes"]["paged"]["paged_runtime"]["prefix_cache"]["hits"] > 0
+    # The int8 column serves the same sweep on the same programs.
+    int8 = art["modes"]["paged-int8"]
+    assert int8["recompiles_after_warmup"] == 0
+    assert int8["rows"] and all(r["completed"] > 0 for r in int8["rows"])
+    assert int8["paged_runtime"]["kv_dtype"] == "int8"
+    assert int8["paged_runtime"]["quantize_self"] is True
 
 
 def _http_get(url, timeout=10.0):
@@ -928,10 +1078,19 @@ class TestObservabilityPlane:
                 check = payload["checks"]["serving"]
                 assert check["healthy"] is False
                 assert check["quarantined"] >= 1
-                # flight dump landed with the victim's full timeline
-                dump = recorder.load_flight(
-                    recorder.flight_path(str(tmp_path))
-                )
+                # flight dump landed with the victim's full timeline.
+                # The quarantine dump is written by the worker thread
+                # AFTER the victim's future fails (it overwrites the
+                # fault-site dump at the same path), so poll for it.
+                dump = {}
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    dump = recorder.load_flight(
+                        recorder.flight_path(str(tmp_path))
+                    )
+                    if "request_traces" in dump.get("extra", {}):
+                        break
+                    time.sleep(0.01)
                 traces = dump["extra"]["request_traces"]
                 assert traces and traces[0]["trace_id"] == \
                     victim.trace.trace_id

@@ -278,14 +278,92 @@ class TestHybridMesh:
         assert isinstance(res.state, zero.Zero1State)
         assert np.isfinite(res.final_loss)
 
-    def test_hybrid_rejects_compressed_wire(self, rng):
-        model, params, _ = self._hybrid_setup(rng)
-        with pytest.raises(ValueError, match="hybrid"):
-            zero.init_sharded(
-                apply_fn=model.apply, params=params,
-                tx=make_optimizer("adam", 1e-2), mesh=data_model_mesh(4),
-                config=zero.Zero1Config(comms_dtype="int8"),
+    # The pure-TP + replicated-DP reference trajectory is identical
+    # across the wire-dtype variants (the rng fixture reseeds per test)
+    # — computed once, same cache discipline as TestZero1Equivalence.
+    _ref_cache: dict = {}
+
+    def _compressed_wire_pair(self, rng, comms_dtype):
+        model, params, batch = self._hybrid_setup(rng)
+        mesh = data_model_mesh(4)
+        loss_fn = classification_loss(model.apply)
+        if "ref" not in self._ref_cache:
+            ref = shard_state(
+                TrainState.create(
+                    apply_fn=model.apply,
+                    params=jax.tree.map(jnp.copy, params),
+                    tx=make_optimizer("adam", 1e-2),
+                ),
+                mesh,
             )
+            ref, _ = self._run(make_train_step(loss_fn), ref, mesh, batch)
+            self._ref_cache["ref"] = jax.device_get(ref.params)
+        zstate = zero.init_sharded(
+            apply_fn=model.apply,
+            params=jax.tree.map(jnp.copy, params),
+            tx=make_optimizer("adam", 1e-2),
+            mesh=mesh,
+            config=zero.Zero1Config(
+                bucket_bytes=64, comms_dtype=comms_dtype
+            ),
+        )
+        zstep = zero.make_zero1_step(loss_fn, mesh, zstate)
+        zstate, _ = self._run(zstep, zstate, mesh, batch)
+        return self._ref_cache["ref"], zstate, zstep
+
+    def test_hybrid_bf16_wire_close(self, rng):
+        """bf16 wire on the hybrid mesh: per-bucket QDQ rounding only,
+        so the trajectory stays within bf16-mantissa tolerance of the
+        pure-TP reference — same documented bound as the explicit path's
+        bf16 gate (docs/PARALLELISM.md wire-dtype matrix)."""
+        ref, zstate, zstep = self._compressed_wire_pair(rng, "bfloat16")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-2
+            ),
+            ref, jax.device_get(zstate.params),
+        )
+        # The byte counters must show the 2x reduce-scatter shrink.
+        fp32 = zero.comms_bytes_per_step(
+            zstate.plan, zero.Zero1Config(bucket_bytes=64)
+        )
+        assert zstep.comms_stats["reduce_scatter_bytes"] == (
+            fp32["reduce_scatter_bytes"] // 2
+        )
+        assert zstep.comms_stats["allgather_bytes"] == (
+            fp32["allgather_bytes"]  # params gather fp32 in every mode
+        )
+
+    def test_hybrid_int8_wire_trains(self, rng):
+        """int8 wire is lossy (per-bucket absmax scale): bounded drift
+        and a finite trajectory — not bit parity — mirroring the
+        explicit path's int8 gate. The int8 rejection guard this
+        replaces is gone: hybrid + compressed wire now composes."""
+        ref, zstate, zstep = self._compressed_wire_pair(rng, "int8")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=0.2
+            ),
+            ref, jax.device_get(zstate.params),
+        )
+        assert all(
+            np.isfinite(np.asarray(x)).all()
+            for x in jax.tree.leaves(jax.device_get(zstate.params))
+        )
+        # TP placement survives the QDQ'd flatten/update/unflatten.
+        specs = [
+            str(getattr(leaf.sharding, "spec", ""))
+            for leaf in jax.tree.leaves(zstate.params)
+        ]
+        assert any(MODEL_AXIS in s for s in specs)
+        # int8 wire: ~4x shrink plus one fp32 scale per bucket.
+        fp32 = zero.comms_bytes_per_step(
+            zstate.plan, zero.Zero1Config(bucket_bytes=64)
+        )
+        n_buckets = len(zstate.plan.buckets)
+        assert zstep.comms_stats["reduce_scatter_bytes"] == (
+            fp32["reduce_scatter_bytes"] // 4 + 4 * n_buckets
+        )
 
 
 class TestFitWiring:
@@ -497,10 +575,26 @@ def test_comms_bench_smoke_subprocess(tmp_path):
     assert on["n_buckets"] > 1
     assert on["exposed_collective_ms_est"] < off["exposed_collective_ms_est"]
     assert off["hidden_fraction"] == 0.0
-    # Hybrid leg: parity with the pure-TP reference + sharded moments.
+    # Hybrid leg: parity with the pure-TP reference + sharded moments,
+    # and the compressed-wire column — smoke runs fp32 + bf16; the bf16
+    # wire halves the reduce-scatter bytes while the allgather stays
+    # fp32, both trajectories inside their parity tolerances.
     assert art["hybrid"]["ok"] is True
     assert art["hybrid"]["parity_ok"] is True
     assert art["hybrid"]["tp_sharding_preserved"] is True
+    hw = art["hybrid"]["wire"]
+    assert set(hw) == {"float32", "bfloat16"}
+    assert hw["bfloat16"]["parity_ok"] is True
+    assert hw["bfloat16"]["tp_sharding_preserved"] is True
+    assert (
+        hw["bfloat16"]["reduce_scatter_bytes"]
+        == hw["float32"]["reduce_scatter_bytes"] // 2
+    )
+    assert (
+        hw["bfloat16"]["allgather_bytes"]
+        == hw["float32"]["allgather_bytes"]
+    )
+    assert hw["bfloat16"]["rs_shrink_vs_fp32"] == 2.0
     assert art["comms"]["collectives"].keys() >= {
         "comms.reduce_scatter", "comms.allgather",
     }

@@ -11,12 +11,27 @@ decode program, chunked prefill, prefix sharing), each sweep
 self-calibrated against its own unloaded capacity so the load fractions
 mean the same thing in both columns.
 
-Four semantic gates ride every run:
+Since the quantized memory plane landed there is a **third column**:
+``paged-int8`` runs the same sweep with ``kv_dtype="int8"`` +
+``quantize_self=True`` (per-page absmax scales on both KV stores), so
+the artifact answers what int8 paging costs (throughput/latency deltas)
+and buys (the equal-HBM concurrency-ceiling column).
 
-- **parity** — the two modes must produce token-identical greedy outputs
-  for the same prompts (the padded path is the equivalence oracle);
-- **zero recompiles** — no program compiles after warmup in either mode,
-  across the whole sweep's occupancy/length mix;
+Six semantic gates ride every run:
+
+- **parity** — padded and paged(fp32) must produce token-identical
+  greedy outputs for the same prompts (the padded path is the
+  equivalence oracle);
+- **token_match** — the int8 engine's greedy outputs against the paged
+  fp32 oracle: position-wise token match rate must be >= 0.99
+  (quantization is allowed rounding noise, not different behavior);
+- **int8_ceiling** — at an equal KV pool byte budget (the fp32 engine's
+  as-built capacity), the int8 engine must fit >= 2x the worst-case
+  resident sequences, scale planes included — the capacity win the
+  quantized plane exists for;
+- **zero recompiles** — no program compiles after warmup in any mode,
+  across the whole sweep's occupancy/length mix (int8 included: scales
+  are data, not shape);
 - **conservation** — every submitted request is accounted completed /
   rejected / expired / failed after the drain;
 - **midload_scrape** — the bench runs with the live observability plane
@@ -27,11 +42,12 @@ Four semantic gates ride every run:
   structural bound — the conservation law holding *under* concurrent
   decode load, not just after the drain.
 
-``--smoke`` is the tier-1 CI entry: tiny model, parity gate, and a short
-paged-only sweep, exiting nonzero if any gate fails. The full run writes
-``BENCH_SERVE_r03.json`` (``--out`` relocates) with both columns, the
-saturation-knee comparison, each engine's metrics ledger (padding-
-waste counters included), and the mid-load snapshot.
+``--smoke`` is the tier-1 CI entry: tiny model, parity + token-match +
+ceiling gates, and a short paged + paged-int8 sweep, exiting nonzero if
+any gate fails. The full run writes ``BENCH_SERVE_r05.json`` (``--out``
+relocates) with all three columns, the saturation-knee comparison, each
+engine's metrics ledger (padding-waste counters included), and the
+mid-load snapshot.
 
 Usage: JAX_PLATFORMS=cpu python tools/serve_bench.py [--smoke] [--out P]
 """
@@ -46,19 +62,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_translator(tiny: bool):
-    """Untrained tiny translator — the bench measures the serving layer
-    (batching, queueing, paging, dispatch), not model quality."""
+    """Lightly-trained tiny translator. Throughput numbers do not care
+    what the parameter values are — but the int8 accuracy oracle does:
+    a randomly-initialized model greedy-decodes off near-tie logits,
+    where ANY rounding noise (bf16, reduction order, int8 scales) flips
+    the argmax, so the token-match gate would measure coin flips instead
+    of quantization. A few hundred teacher-forced steps give the logits
+    decisive margins; the serving layer under test is unchanged."""
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from machine_learning_apache_spark_tpu.data.datasets import (
         synthetic_translation_pairs,
     )
-    from machine_learning_apache_spark_tpu.data.text import TextPipeline
+    from machine_learning_apache_spark_tpu.data.text import (
+        PAD_ID,
+        TextPipeline,
+    )
     from machine_learning_apache_spark_tpu.inference import Translator
     from machine_learning_apache_spark_tpu.models import (
         Transformer,
         TransformerConfig,
+    )
+    from machine_learning_apache_spark_tpu.recipes.translation import (
+        make_translation_loss,
+    )
+    from machine_learning_apache_spark_tpu.train.loop import make_train_step
+    from machine_learning_apache_spark_tpu.train.state import (
+        TrainState,
+        make_optimizer,
     )
 
     pairs = synthetic_translation_pairs(256, min_len=3, max_len=8, seed=0)
@@ -75,6 +108,23 @@ def build_translator(tiny: bool):
     dummy = np.ones((2, 8), np.int32)
     params = model.init(jax.random.key(0), dummy, dummy)["params"]
     texts = [s for s, _ in pairs]
+
+    src = np.asarray(src_pipe(texts))
+    trg = np.asarray(trg_pipe([t for _, t in pairs]))
+    state = TrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=make_optimizer("adam", 3e-3),
+    )
+    step = make_train_step(make_translation_loss(model, PAD_ID))
+    gen = np.random.default_rng(0)
+    key = jax.random.key(1)
+    for i in range(150 if tiny else 300):
+        idx = gen.integers(0, len(src), 64)
+        state, _, _ = step(
+            state, (jnp.asarray(src[idx]), jnp.asarray(trg[idx])),
+            jax.random.fold_in(key, i),
+        )
+    params = jax.device_get(state.params)
     return Translator(model, params, src_pipe, trg_pipe), texts
 
 
@@ -139,6 +189,18 @@ def _r4(v):
     return None if v is None else round(v, 4)
 
 
+#: Engine kwargs per sweep column. ``paged-int8`` quantizes BOTH KV
+#: stores — the SELF store too (``quantize_self``), since the ceiling
+#: column claims the whole pool budget shrinks, not just the MEM plane.
+ENGINE_MODES = {
+    "padded": {"kv_mode": "padded"},
+    "paged": {"kv_mode": "paged"},
+    "paged-int8": {
+        "kv_mode": "paged", "kv_dtype": "int8", "quantize_self": True,
+    },
+}
+
+
 def parity_gate(translator, texts, n: int, knobs: dict) -> dict:
     """The equivalence oracle: the same prompts through both KV modes
     must produce token-identical greedy outputs."""
@@ -155,6 +217,90 @@ def parity_gate(translator, texts, n: int, knobs: dict) -> dict:
         "checked": n,
         "identical": not mismatches,
         "mismatches": mismatches[:8],
+    }
+
+
+def token_match_gate(translator, texts, n: int, knobs: dict) -> dict:
+    """The int8 accuracy oracle: the same prompts greedy-decoded through
+    the paged fp32 engine (the oracle run) and the paged-int8 engine.
+    Quantization is lossy by construction, so the gate is a rate, not
+    bit-identity: position-wise token agreement (divergence-cascade
+    honest — tokens after the first flip count as mismatched) must stay
+    >= 0.99."""
+    outs = {}
+    for mode in ("paged", "paged-int8"):
+        with translator.serve(**{**knobs, **ENGINE_MODES[mode]}) as eng:
+            futs = [eng.submit(t) for t in texts[:n]]
+            outs[mode] = [f.result(timeout=120) for f in futs]
+    matched = total = 0
+    mismatches = []
+    for i, (a, b) in enumerate(zip(outs["paged"], outs["paged-int8"])):
+        ta = translator.trg_pipe.ragged([a])[0]
+        tb = translator.trg_pipe.ragged([b])[0]
+        agree = 0
+        for x, y in zip(ta, tb):
+            if x != y:
+                break
+            agree += 1
+        matched += agree
+        total += max(len(ta), len(tb))
+        if a != b:
+            mismatches.append(i)
+    rate = matched / total if total else 1.0
+    return {
+        "checked": n,
+        "token_match_rate": round(rate, 4),
+        "identical_outputs": n - len(mismatches),
+        "mismatches": mismatches[:8],
+        "ok": rate >= 0.99,
+    }
+
+
+def concurrency_ceiling(translator, knobs: dict) -> dict:
+    """Equal-HBM concurrency ceiling: with the SAME KV pool byte budget
+    (the fp32 engine's as-built capacity, MEM + SELF), how many
+    worst-case resident sequences fit under each kv dtype? Pages-per-
+    sequence and per-page byte costs come from each engine's own
+    runtime accounting — the int8 column pays for its fp32 scale planes
+    in the same ledger — so the ratio is the honest capacity win, not
+    element-size arithmetic."""
+    cols = {}
+    for mode in ("paged", "paged-int8"):
+        eng = translator.serve(
+            start=False, **{**knobs, **ENGINE_MODES[mode]}
+        )
+        rt = eng.runtime
+        st = rt.stats()
+        cols[mode] = {
+            "kv_dtype": st["kv_dtype"],
+            "quantize_self": st["quantize_self"],
+            "mem_page_bytes": st["mem_page_bytes"],
+            "self_page_bytes": st["self_page_bytes"],
+            "mem_pages_per_seq": rt.mem_pages,
+            "self_pages_per_seq": rt.self_pages,
+            "bytes_per_resident_seq": (
+                rt.mem_pages * st["mem_page_bytes"]
+                + rt.self_pages * st["self_page_bytes"]
+            ),
+            "pool_bytes_as_built": (
+                st["mem_bytes_capacity"] + st["self_bytes_capacity"]
+            ),
+        }
+    budget = cols["paged"]["pool_bytes_as_built"]
+    for col in cols.values():
+        col["ceiling_at_equal_bytes"] = (
+            budget // col["bytes_per_resident_seq"]
+        )
+    ratio = (
+        cols["paged-int8"]["ceiling_at_equal_bytes"]
+        / cols["paged"]["ceiling_at_equal_bytes"]
+    )
+    return {
+        "pool_bytes_budget": budget,
+        "float32": cols["paged"],
+        "int8": cols["paged-int8"],
+        "int8_ceiling_vs_fp32": round(ratio, 3),
+        "ok": ratio >= 2.0,
     }
 
 
@@ -203,7 +349,7 @@ def run_mode(translator, texts, mode: str, knobs: dict,
     """One mode's full sweep on its own engine: calibrate unloaded
     capacity, sweep load fractions of it, assert conservation — and, at
     the saturation level, scrape the live plane mid-traffic."""
-    engine = translator.serve(**{**knobs, "kv_mode": mode})
+    engine = translator.serve(**{**knobs, **ENGINE_MODES[mode]})
     with engine:
         # Steady-state warm pass (both modes, same traffic): every
         # distinct prompt once, so calibration measures the serving
@@ -280,14 +426,14 @@ def run_mode(translator, texts, mode: str, knobs: dict,
             "conservation": ledger,
             "midload_scrape": scrape,
         }
-        if mode == "paged":
+        if mode != "padded":
             result["paged_runtime"] = engine.runtime.stats()
     return result
 
 
 def main() -> None:
     smoke = "--smoke" in sys.argv
-    out_path = "BENCH_SERVE_r03.json"
+    out_path = "BENCH_SERVE_r05.json"
     if "--out" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
     if smoke:
@@ -323,10 +469,19 @@ def main() -> None:
     )
     parity = parity_gate(translator, texts, 12 if smoke else 64, knobs)
     print(json.dumps({"parity": parity}), flush=True)
+    token_match = token_match_gate(
+        translator, texts, 12 if smoke else 64, knobs
+    )
+    print(json.dumps({"token_match": token_match}), flush=True)
+    ceiling = concurrency_ceiling(translator, knobs)
+    print(json.dumps({"concurrency_ceiling": ceiling}), flush=True)
 
     duration = 1.5 if smoke else 8.0
     fractions = (0.25, 1.0) if smoke else (0.25, 0.5, 1.0, 1.5)
-    sweep_modes = ("paged",) if smoke else ("padded", "paged")
+    sweep_modes = (
+        ("paged", "paged-int8") if smoke
+        else ("padded", "paged", "paged-int8")
+    )
     modes = {
         m: run_mode(translator, texts, m, knobs, duration, fractions)
         for m in sweep_modes
@@ -334,6 +489,8 @@ def main() -> None:
 
     gates = {
         "parity": parity["identical"],
+        "token_match": token_match["ok"],
+        "int8_ceiling": ceiling["ok"],
         "zero_recompiles": all(
             m["recompiles_after_warmup"] == 0 for m in modes.values()
         ),
@@ -362,6 +519,18 @@ def main() -> None:
                      or pg["p99_latency_s"] <= pad["p99_latency_s"])
             ),
         }
+        if "paged-int8" in modes:
+            # The quantized plane must not cost throughput: its
+            # saturation knee stays within 5% of the fp32 paged column
+            # measured in the SAME run (same machine conditions — the
+            # honest form of "within 5% of the r03 baseline").
+            q = _at_one("paged-int8")
+            knee["paged_int8_tokens_per_sec"] = q["tokens_per_sec"]
+            knee["paged_int8_p99_s"] = q["p99_latency_s"]
+            knee["int8_vs_paged_ratio"] = round(
+                q["tokens_per_sec"] / pg["tokens_per_sec"], 4
+            )
+            gates["int8_knee"] = knee["int8_vs_paged_ratio"] >= 0.95
         gates["knee"] = knee["paged_beats_padded"]
 
     ok = all(gates.values())
@@ -371,6 +540,8 @@ def main() -> None:
         "platform": _platform(),
         "duration_per_level_s": duration,
         "parity": parity,
+        "token_match": token_match,
+        "concurrency_ceiling": ceiling,
         "modes": modes,
         "knee": knee,
         "gates": gates,
