@@ -42,6 +42,11 @@ import os as _os
 #   MLSPARK_PLATFORM=cpu MLSPARK_CPU_DEVICES=8 python examples/cnn.py
 #
 # No-ops (with a warning) if the backend was already initialized.
+#
+# Direct reads by design: this block must run before the first jax import
+# settles a platform, and utils.env sits in the jax-importing utils package.
+# Both names ARE registered; only the accessor differs.
+# mlspark-lint: ok env-direct-read -- pre-platform bootstrap, see comment above
 if _os.environ.get("MLSPARK_PLATFORM") or _os.environ.get("MLSPARK_CPU_DEVICES"):
     import jax as _jax
 
@@ -65,14 +70,14 @@ if _os.environ.get("MLSPARK_PLATFORM") or _os.environ.get("MLSPARK_CPU_DEVICES")
             stacklevel=2,
         )
     else:
-        if _os.environ.get("MLSPARK_PLATFORM"):
-            _jax.config.update("jax_platforms", _os.environ["MLSPARK_PLATFORM"])
-        if _os.environ.get("MLSPARK_CPU_DEVICES"):
+        if _os.environ.get("MLSPARK_PLATFORM"):  # mlspark-lint: ok env-direct-read -- pre-platform bootstrap, see top of block
+            _jax.config.update("jax_platforms", _os.environ["MLSPARK_PLATFORM"])  # mlspark-lint: ok env-direct-read -- pre-platform bootstrap
+        if _os.environ.get("MLSPARK_CPU_DEVICES"):  # mlspark-lint: ok env-direct-read -- pre-platform bootstrap, see top of block
             from machine_learning_apache_spark_tpu.utils.jax_compat import (
                 set_num_cpu_devices as _set_num_cpu_devices,
             )
 
-            _set_num_cpu_devices(int(_os.environ["MLSPARK_CPU_DEVICES"]))
+            _set_num_cpu_devices(int(_os.environ["MLSPARK_CPU_DEVICES"]))  # mlspark-lint: ok env-direct-read -- pre-platform bootstrap
 
 from machine_learning_apache_spark_tpu.session import Session, SessionBuilder
 

@@ -1,0 +1,48 @@
+"""mlspark-lint — repo-native static analysis for the invariants the
+test suite can't see.
+
+The codebase's correctness contracts are mostly *negative* properties:
+no host sync inside a jit-reachable function (the zero-recompile serving
+invariant), no unlocked access to state shared across serving/fleet/
+telemetry threads, no ``MLSPARK_*`` read that bypasses the env registry,
+no jitted step that silently double-buffers large state. Tests prove the
+happy path; these passes prove the absence classes, mechanically, on
+every tree (the veScale argument: eager-SPMD correctness contracts must
+be checked by tooling, not review).
+
+Four passes (see docs/STATIC_ANALYSIS.md for the full rule list and the
+pragma grammar):
+
+- ``recompile``  — host-sync / recompile hazards in functions reachable
+  from ``jax.jit`` roots (call-graph walk over the package);
+- ``locks``      — ``# guarded-by:`` lock-discipline for attributes and
+  module globals shared across threads;
+- ``env``        — every ``MLSPARK_*`` access goes through
+  ``utils/env.py``; registry and ``docs/ENV.md`` agree;
+- ``jit``        — ``donate_argnums`` on large-state steps and hashable
+  ``static_argnums`` call sites.
+
+Everything here is stdlib-``ast`` only — the suite runs without
+importing the package under analysis (no JAX import), so the tier-1
+subprocess gate stays cheap.
+"""
+
+from machine_learning_apache_spark_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    Module,
+    load_tree,
+)
+from machine_learning_apache_spark_tpu.analysis.run import (
+    PASSES,
+    run_lint,
+)
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "Module",
+    "PASSES",
+    "load_tree",
+    "run_lint",
+]

@@ -1,0 +1,170 @@
+"""Pass ``jit`` — hygiene of ``jax.jit`` applications.
+
+Two rules, both aimed at the train/serve hot paths:
+
+- ``jit-donate`` (warning): a jitted function whose parameters include
+  large state (``state``, ``train_state``, ``opt_state``) but whose jit
+  application declares no ``donate_argnums``/``donate_argnames``. Without
+  donation the updated state double-buffers: peak HBM grows by a full
+  optimizer-state copy per step. Warning, not error — eval-style steps
+  legitimately keep their input state.
+- ``jit-static-hashable`` (error): a call to a jitted function passing
+  an unhashable literal (list/dict/set, or comprehension thereof) at a
+  ``static_argnums`` position. JAX raises at runtime, but only on the
+  first call on that code path — the lint catches the latent ones.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from machine_learning_apache_spark_tpu.analysis.callgraph import (
+    _is_jit_expr,
+    jit_application,
+)
+from machine_learning_apache_spark_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    Module,
+)
+
+__all__ = ["run_jit", "RULES"]
+
+RULES = {
+    "jit-donate": "warning",
+    "jit-static-hashable": "error",
+}
+
+_STATE_PARAMS = {"state", "train_state", "opt_state"}
+_UNHASHABLE = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp,
+)
+
+
+def _kwargs_of(app: ast.Call) -> dict[str, ast.AST]:
+    return {k.arg: k.value for k in app.keywords if k.arg}
+
+
+def _static_positions(app: ast.Call) -> list[int]:
+    """Literal int positions from ``static_argnums`` (best-effort)."""
+    kw = _kwargs_of(app)
+    node = kw.get("static_argnums")
+    if node is None:
+        return []
+    try:
+        val = ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return []
+    if isinstance(val, int):
+        return [val]
+    if isinstance(val, (tuple, list)):
+        return [v for v in val if isinstance(v, int)]
+    return []
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        return [p.arg for p in [*a.posonlyargs, *a.args]]
+    return []
+
+
+def run_jit(
+    modules: list[Module], config: LintConfig, root: str
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        # jitted-name -> static positions, for the call-site check
+        static_by_name: dict[str, list[int]] = {}
+
+        def _fn_by_name(name: str) -> ast.AST | None:
+            for n in ast.walk(mod.tree):
+                if isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and n.name == name:
+                    return n
+            return None
+
+        def check_app(app: ast.Call, fn: ast.AST | None, line: int,
+                      label: str) -> None:
+            kw = _kwargs_of(app)
+            if fn is not None and (
+                "donate_argnums" not in kw and "donate_argnames" not in kw
+            ):
+                hit = _STATE_PARAMS.intersection(_param_names(fn))
+                if hit:
+                    findings.append(Finding(
+                        rule="jit-donate",
+                        severity=RULES["jit-donate"],
+                        path=mod.path,
+                        line=line,
+                        message=(
+                            f"jitted `{label}` takes large state "
+                            f"(`{sorted(hit)[0]}`) but declares no "
+                            "donate_argnums — the update double-buffers"
+                            " a full state copy in HBM"
+                        ),
+                    ))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    app = jit_application(dec)
+                    if app is not None:
+                        check_app(app, node, node.lineno, node.name)
+                        static_by_name[node.name] = _static_positions(app)
+                    elif _is_jit_expr(dec):
+                        # bare @jax.jit / @jit decorator — no kwargs at
+                        # all, so no donation either
+                        fake = ast.Call(func=dec, args=[], keywords=[])
+                        check_app(fake, node, node.lineno, node.name)
+                        static_by_name[node.name] = []
+            elif isinstance(node, ast.Assign):
+                app = jit_application(node.value)
+                if app is None:
+                    continue
+                # step = jax.jit(fn, static_argnums=...) — resolve fn for
+                # the donate check, remember the bound name for call sites
+                target_fn: ast.AST | None = None
+                label = "<jit>"
+                if app.args:
+                    first = app.args[0]
+                    if isinstance(first, ast.Lambda):
+                        target_fn = first
+                        label = "<lambda>"
+                    elif isinstance(first, ast.Name):
+                        target_fn = _fn_by_name(first.id)
+                        label = first.id
+                check_app(app, target_fn, node.lineno, label)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        static_by_name[t.id] = _static_positions(app)
+
+        # call-site hashability for names with static positions
+        hot = {n: p for n, p in static_by_name.items() if p}
+        if hot:
+            for node in ast.walk(mod.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in hot
+                ):
+                    continue
+                for pos in hot[node.func.id]:
+                    if pos < len(node.args) and isinstance(
+                        node.args[pos], _UNHASHABLE
+                    ):
+                        findings.append(Finding(
+                            rule="jit-static-hashable",
+                            severity=RULES["jit-static-hashable"],
+                            path=mod.path,
+                            line=node.lineno,
+                            message=(
+                                f"argument {pos} of `{node.func.id}` is "
+                                "static_argnums but this call passes an "
+                                "unhashable literal — jit will raise on "
+                                "first call; pass a tuple or hashable "
+                                "value"
+                            ),
+                        ))
+    return findings

@@ -1,0 +1,176 @@
+"""Pass ``recompile`` — host-sync / recompile hazards in jitted code.
+
+The zero-recompile serving contract (PR 8/13) and the train-step hot
+path both die quietly when host Python leaks into a traced function: a
+``.item()`` or ``float(x)`` forces a device sync per step, ``np.asarray``
+pulls the array to host and constant-folds it into the *next* trace,
+``os.environ``/``time.time()`` reads bake trace-time values into the
+compiled program (and make "same code, different program" recompiles
+possible). None of this throws — it just costs throughput or correctness
+later.
+
+This pass walks every function reachable from a ``jax.jit`` root (see
+``callgraph.py`` for what "reachable" means) and flags:
+
+==============================  ============================================
+rule                            trigger
+==============================  ============================================
+``recompile-item``              ``x.item()`` / ``x.tolist()``
+``recompile-cast``              ``float(name)`` / ``int(name)`` / ``bool(name)``
+                                on a bare name (the classic host-sync cast;
+                                shape arithmetic like ``int(x.shape[0])``
+                                is deliberately not matched)
+``recompile-asarray``           ``np.asarray`` / ``np.array`` /
+                                ``numpy.asarray`` / ``numpy.array``
+``recompile-device-get``        ``jax.device_get`` /
+                                ``x.block_until_ready()``
+``recompile-time``              ``time.time/monotonic/perf_counter``
+``recompile-env``               any ``os.environ`` / ``os.getenv`` touch
+==============================  ============================================
+
+All severity *error*: a deliberate host round-trip in traced code is
+exactly what the pragma exists for —
+``# mlspark-lint: ok recompile-<rule> -- why``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from machine_learning_apache_spark_tpu.analysis.callgraph import (
+    FuncInfo,
+    build_call_graph,
+)
+from machine_learning_apache_spark_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    Module,
+)
+
+__all__ = ["run_recompile", "RULES"]
+
+RULES = {
+    "recompile-item": "error",
+    "recompile-cast": "error",
+    "recompile-asarray": "error",
+    "recompile-device-get": "error",
+    "recompile-time": "error",
+    "recompile-env": "error",
+}
+
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_TIME_FNS = {"time", "monotonic", "perf_counter", "perf_counter_ns"}
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    ) or (isinstance(node, ast.Name) and node.id == "environ")
+
+
+def _hazards_in(info: FuncInfo) -> list[tuple[str, int, str]]:
+    """(rule, line, detail) for every hazard lexically inside ``info``."""
+    out: list[tuple[str, int, str]] = []
+    node = info.node
+    body = (
+        node.body
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        else [node.body]
+    )
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr in ("item", "tolist") and not n.args:
+                        out.append((
+                            "recompile-item", n.lineno,
+                            f"`.{f.attr}()` forces a device->host sync",
+                        ))
+                    elif f.attr == "block_until_ready":
+                        out.append((
+                            "recompile-device-get", n.lineno,
+                            "`.block_until_ready()` is a host sync",
+                        ))
+                    elif (
+                        f.attr in ("asarray", "array")
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in _NUMPY_ALIASES
+                    ):
+                        out.append((
+                            "recompile-asarray", n.lineno,
+                            f"`{f.value.id}.{f.attr}` materializes on host "
+                            "and constant-folds into the trace",
+                        ))
+                    elif (
+                        f.attr == "device_get"
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "jax"
+                    ):
+                        out.append((
+                            "recompile-device-get", n.lineno,
+                            "`jax.device_get` is a host sync",
+                        ))
+                    elif (
+                        f.attr in _TIME_FNS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "time"
+                    ):
+                        out.append((
+                            "recompile-time", n.lineno,
+                            f"`time.{f.attr}()` reads the host clock at "
+                            "trace time (baked into the program)",
+                        ))
+                    elif f.attr == "getenv" and isinstance(
+                        f.value, ast.Name
+                    ) and f.value.id == "os":
+                        out.append((
+                            "recompile-env", n.lineno,
+                            "`os.getenv` read at trace time",
+                        ))
+                    elif f.attr == "get" and _is_os_environ(f.value):
+                        out.append((
+                            "recompile-env", n.lineno,
+                            "`os.environ.get` read at trace time",
+                        ))
+                elif isinstance(f, ast.Name) and f.id in (
+                    "float", "int", "bool"
+                ):
+                    if len(n.args) == 1 and isinstance(n.args[0], ast.Name):
+                        out.append((
+                            "recompile-cast", n.lineno,
+                            f"`{f.id}({n.args[0].id})` on a traced value "
+                            "is a host sync",
+                        ))
+            elif isinstance(n, ast.Subscript) and _is_os_environ(n.value):
+                out.append((
+                    "recompile-env", n.lineno,
+                    "`os.environ[...]` read at trace time",
+                ))
+    return out
+
+
+def run_recompile(
+    modules: list[Module], config: LintConfig, root: str
+) -> list[Finding]:
+    graph = build_call_graph(modules)
+    roots = graph.jit_roots()
+    reachable = graph.reachable(roots)
+    findings: list[Finding] = []
+    for qual, origin in sorted(reachable.items()):
+        info = graph.defs[qual]
+        for rule, line, detail in _hazards_in(info):
+            findings.append(Finding(
+                rule=rule,
+                severity=RULES[rule],
+                path=info.module.path,
+                line=line,
+                message=(
+                    f"{detail} — inside `{qual}`, reachable from a jit "
+                    f"root ({origin})"
+                ),
+            ))
+    return findings

@@ -339,7 +339,9 @@ class TextPipeline:
         the semantic reference (parity pinned by tests/test_native.py)."""
         import os as _os
 
-        if _os.environ.get("MLSPARK_NO_NATIVE_TEXT"):
+        from machine_learning_apache_spark_tpu.utils import env as envcfg
+
+        if envcfg.get_bool("MLSPARK_NO_NATIVE_TEXT"):
             return None
         # Only for the ACTUAL built-in functions — comparing against the
         # registry entry would pass a custom tokenizer registered over a

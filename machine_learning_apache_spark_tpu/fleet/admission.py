@@ -121,9 +121,12 @@ class FleetAdmission:
         self.tiers = dict(tiers) if tiers is not None else default_tiers()
         if not self.tiers:
             raise ValueError("at least one SLO tier is required")
-        env_quota = os.environ.get("MLSPARK_FLEET_TENANT_MAX_IN_FLIGHT")
-        if tenant_max_in_flight is None and env_quota:
-            tenant_max_in_flight = int(env_quota)
+        if tenant_max_in_flight is None:
+            from machine_learning_apache_spark_tpu.utils import env as envcfg
+
+            tenant_max_in_flight = envcfg.get_int(
+                "MLSPARK_FLEET_TENANT_MAX_IN_FLIGHT"
+            )
         if tenant_max_in_flight is not None and tenant_max_in_flight < 1:
             raise ValueError(
                 f"tenant_max_in_flight must be >= 1, got "

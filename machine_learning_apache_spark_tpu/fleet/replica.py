@@ -40,6 +40,7 @@ from machine_learning_apache_spark_tpu.serving.queue import (
 )
 from machine_learning_apache_spark_tpu.telemetry import events as _events
 from machine_learning_apache_spark_tpu.telemetry import http as _thttp
+from machine_learning_apache_spark_tpu.utils import env as envcfg
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -86,7 +87,7 @@ def write_fleet_sidecar(
 def fleet_dir() -> str | None:
     """Where fleet sidecars and the stop marker live:
     ``MLSPARK_FLEET_DIR`` > telemetry dir."""
-    return os.environ.get("MLSPARK_FLEET_DIR") or _events.telemetry_dir()
+    return envcfg.get_str("MLSPARK_FLEET_DIR") or _events.telemetry_dir()
 
 
 class _ReplicaHandler(BaseHTTPRequestHandler):
@@ -346,7 +347,7 @@ def serve_replica(
     ``MLSPARK_SERVE_KV_DTYPE`` exported to every rank)."""
     d = directory or fleet_dir() or "."
     if port is None:
-        port = int(os.environ.get("MLSPARK_FLEET_PORT", "0"))
+        port = envcfg.get_int("MLSPARK_FLEET_PORT")
     knobs = dict(engine_knobs or {})
     engine = translator.serve(start=False, **knobs)
     stop_marker = os.path.join(d, STOP_MARKER)

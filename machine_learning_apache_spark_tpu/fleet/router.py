@@ -197,10 +197,10 @@ class FleetRouter:
         request_timeout_s: float = 120.0,
         clock=time.monotonic,
     ):
-        import os
+        from machine_learning_apache_spark_tpu.utils import env as envcfg
 
         if policy is None:
-            policy = os.environ.get("MLSPARK_FLEET_POLICY", "affinity")
+            policy = envcfg.get_str("MLSPARK_FLEET_POLICY")
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r} (pick from {POLICIES}; check "
@@ -212,8 +212,8 @@ class FleetRouter:
                 "explicit snapshot_source"
             )
         if scrape_interval is None:
-            scrape_interval = float(
-                os.environ.get("MLSPARK_FLEET_SCRAPE_INTERVAL", "0.5")
+            scrape_interval = envcfg.get_float(
+                "MLSPARK_FLEET_SCRAPE_INTERVAL"
             )
         self.policy = policy
         self.key_fn = key_fn

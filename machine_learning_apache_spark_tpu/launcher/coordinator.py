@@ -23,6 +23,7 @@ from dataclasses import dataclass
 import jax
 
 from machine_learning_apache_spark_tpu.config import SessionConfig
+from machine_learning_apache_spark_tpu.utils import env as envcfg
 
 # Framework-native env names, with the reference's torch names as fallbacks.
 ENV_COORDINATOR = "MLSPARK_COORDINATOR"
@@ -47,13 +48,15 @@ class RendezvousSpec:
         if conf.coordinator_address and conf.num_processes > 1:
             return cls(conf.coordinator_address, conf.num_processes, max(conf.process_id, 0))
 
-        addr = os.environ.get(ENV_COORDINATOR)
+        addr = envcfg.get_str(ENV_COORDINATOR)
         if addr is None and "MASTER_ADDR" in os.environ:
             addr = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', '29500')}"
-        world = int(
-            os.environ.get(ENV_NUM_PROCESSES, os.environ.get("WORLD_SIZE", "1"))
-        )
-        rank = int(os.environ.get(ENV_PROCESS_ID, os.environ.get("RANK", "0")))
+        world = envcfg.get_int(ENV_NUM_PROCESSES, default=None)
+        if world is None:
+            world = int(os.environ.get("WORLD_SIZE", "1"))
+        rank = envcfg.get_int(ENV_PROCESS_ID, default=None)
+        if rank is None:
+            rank = int(os.environ.get("RANK", "0"))
         if addr is None or world <= 1:
             return None
         return cls(addr, world, rank)

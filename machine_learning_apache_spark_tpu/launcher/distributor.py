@@ -49,6 +49,7 @@ from machine_learning_apache_spark_tpu.launcher.monitor import (
     GangMonitor,
     terminate_gang,
 )
+from machine_learning_apache_spark_tpu.utils import env as envcfg
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -464,7 +465,7 @@ class Distributor:
         the ephemeral workdir)."""
         return (
             self.extra_env.get("MLSPARK_TELEMETRY_DIR")
-            or os.environ.get("MLSPARK_TELEMETRY_DIR")
+            or envcfg.get_str("MLSPARK_TELEMETRY_DIR")
             or workdir
         )
 
@@ -533,25 +534,31 @@ class Distributor:
             # MLSPARK_DP_MODE (fit() resolves it when dp_mode isn't passed
             # explicitly); an inherited MLSPARK_DP_MODE flows through
             # dict(os.environ) above, and explicit env= still wins below.
+            # Writes go through the registry (envcfg.put_into): a typo'd
+            # contract name fails here at the driver, not as a silently
+            # ignored variable in every rank.
             if self.dp_mode is not None:
-                env["MLSPARK_DP_MODE"] = self.dp_mode
+                envcfg.put_into(env, "MLSPARK_DP_MODE", self.dp_mode)
             if self.dp_overlap is not None:
-                env["MLSPARK_ZERO1_OVERLAP"] = "1" if self.dp_overlap else "0"
+                envcfg.put_into(
+                    env, "MLSPARK_ZERO1_OVERLAP",
+                    "1" if self.dp_overlap else "0",
+                )
             # Serving KV mode rides the same contract (constructor >
             # inherited env; explicit env= still wins below).
             if self.serve_kv_mode is not None:
-                env["MLSPARK_SERVE_KV_MODE"] = self.serve_kv_mode
+                envcfg.put_into(env, "MLSPARK_SERVE_KV_MODE", self.serve_kv_mode)
             if self.serve_kv_dtype is not None:
-                env["MLSPARK_SERVE_KV_DTYPE"] = self.serve_kv_dtype
+                envcfg.put_into(env, "MLSPARK_SERVE_KV_DTYPE", self.serve_kv_dtype)
             # Observability-plane port knob, same contract shape.
             if self.telemetry_http is not None:
-                env["MLSPARK_TELEMETRY_HTTP"] = str(self.telemetry_http)
+                envcfg.put_into(env, "MLSPARK_TELEMETRY_HTTP", self.telemetry_http)
             # Elastic opt-in rides the same contract: the workers' fit()
             # resolves MLSPARK_ELASTIC when elastic= isn't passed, so a
             # shrunken gang reshards old-topology checkpoints instead of
             # refusing them (train/reshard.py).
             if self.elastic:
-                env["MLSPARK_ELASTIC"] = "1"
+                envcfg.put_into(env, "MLSPARK_ELASTIC", "1")
             # Ingest knobs ride the same contract: constructor > inherited
             # env (explicit env= still wins below).
             env.update(self.ingest_env)
@@ -561,12 +568,14 @@ class Distributor:
             # MLSPARK_TELEMETRY_DIR (e.g. a persistent dir from the fault
             # drill) wins — the workdir is ephemeral (rmtree'd below).
             env.setdefault("MLSPARK_TELEMETRY_DIR", workdir)
-            env["MLSPARK_COORDINATOR"] = coord
-            env["MLSPARK_NUM_PROCESSES"] = str(n)
-            env["MLSPARK_PROCESS_ID"] = str(rank)
-            env["MLSPARK_GANG_ATTEMPT"] = str(attempt)
-            env["MLSPARK_HEARTBEAT_FILE"] = heartbeat_path
-            env["MLSPARK_HEARTBEAT_INTERVAL"] = str(self.heartbeat_interval)
+            envcfg.put_into(env, "MLSPARK_COORDINATOR", coord)
+            envcfg.put_into(env, "MLSPARK_NUM_PROCESSES", n)
+            envcfg.put_into(env, "MLSPARK_PROCESS_ID", rank)
+            envcfg.put_into(env, "MLSPARK_GANG_ATTEMPT", attempt)
+            envcfg.put_into(env, "MLSPARK_HEARTBEAT_FILE", heartbeat_path)
+            envcfg.put_into(
+                env, "MLSPARK_HEARTBEAT_INTERVAL", self.heartbeat_interval
+            )
             host, _, port = coord.partition(":")
             env["MASTER_ADDR"], env["MASTER_PORT"] = host, port
             env["WORLD_SIZE"], env["RANK"] = str(n), str(rank)
@@ -575,7 +584,7 @@ class Distributor:
                 # for the runner's config-API override (the axon sitecustomize
                 # ignores JAX_PLATFORMS — see runner.main).
                 env["JAX_PLATFORMS"] = self.platform
-                env["MLSPARK_PLATFORM"] = self.platform
+                envcfg.put_into(env, "MLSPARK_PLATFORM", self.platform)
             env["PYTHONPATH"] = os.pathsep.join(
                 p for p in sys.path if p
             )

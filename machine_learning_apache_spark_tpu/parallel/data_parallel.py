@@ -102,6 +102,7 @@ def make_data_parallel_eval_step(loss_fn: Callable, mesh: Mesh, *, axis: str = D
     )
 
     @jax.jit
+    # mlspark-lint: ok jit-donate -- eval step: state is read, not updated; donating would consume the caller's buffers
     def step(state: TrainState, batch, rng: jax.Array):
         return sharded(state.params, batch, rng)
 

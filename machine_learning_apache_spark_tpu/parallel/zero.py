@@ -86,6 +86,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from machine_learning_apache_spark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from machine_learning_apache_spark_tpu.utils import env as envcfg
 from machine_learning_apache_spark_tpu.utils.jax_compat import shard_map
 
 # Environment contract (launcher gang plumbing: the driver sets these on
@@ -108,7 +109,9 @@ _WIRE_ITEMSIZE = {"float32": 4, "bfloat16": 2, "int8": 1}
 
 def resolve_dp_mode(dp_mode: str | None) -> str:
     """Explicit argument > ``MLSPARK_DP_MODE`` env > ``"replicated"``."""
-    mode = dp_mode or os.environ.get(ENV_DP_MODE) or "replicated"
+    # raw() rather than get_str(): the registry's choices check would raise
+    # before this guard, and callers rely on the dp_mode-named message below.
+    mode = dp_mode or envcfg.raw(ENV_DP_MODE) or "replicated"
     if mode not in DP_MODES:
         raise ValueError(f"unknown dp_mode {mode!r} (expected one of {DP_MODES})")
     return mode
@@ -164,13 +167,11 @@ class Zero1Config:
         """Explicit arguments win; unset ones fall back to the launcher
         env contract, then to defaults."""
         if bucket_bytes is None:
-            bucket_bytes = int(
-                os.environ.get(ENV_BUCKET_BYTES, DEFAULT_BUCKET_BYTES)
-            )
+            bucket_bytes = envcfg.get_int(ENV_BUCKET_BYTES, DEFAULT_BUCKET_BYTES)
         if comms_dtype is None:
-            comms_dtype = os.environ.get(ENV_COMMS_DTYPE, "float32")
+            comms_dtype = envcfg.get_str(ENV_COMMS_DTYPE)
         if overlap is None:
-            raw = os.environ.get(ENV_OVERLAP)
+            raw = envcfg.raw(ENV_OVERLAP)
             overlap = True if raw is None else _parse_bool(raw, env=ENV_OVERLAP)
         return cls(
             axis=axis,

@@ -29,6 +29,7 @@ import numpy as np
 
 from machine_learning_apache_spark_tpu import telemetry
 from machine_learning_apache_spark_tpu.data.text import EOS_ID, SOS_ID
+from machine_learning_apache_spark_tpu.utils import env as envcfg
 from machine_learning_apache_spark_tpu.serving.batcher import (
     Batch,
     Batcher,
@@ -60,6 +61,43 @@ class InternalError(RuntimeError):
     programs were compiled at warmup — recovery triggers zero recompiles.
     The original exception rides along as ``__cause__``.
     """
+
+
+class _HealthWindow:
+    """The /healthz quarantine-recovery window, shared between the decode
+    worker (writes) and HTTP scrape threads (reads). Both timestamps move
+    under one lock so a reader always sees a (quarantine, ok-batch) pair
+    that actually coexisted. The previous two-bare-loads read was pair-
+    consistent only by accident of CPython's bytecode-level GIL switching
+    (no call between the loads); any refactor inserting one — or a
+    free-threaded build — could pair a fresh ok-batch time with a stale
+    quarantine time and report "recovered" mid-degraded-window. The lock
+    makes the guarantee structural; ``tests/test_analysis_races.py``
+    hammers it from 4 threads."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._last_quarantine_t: float | None = None  # guarded-by: self._lock
+        self._last_ok_batch_t: float | None = None  # guarded-by: self._lock
+
+    def note_quarantine(self, t: float) -> None:
+        with self._lock:
+            self._last_quarantine_t = t
+
+    def note_ok_batch(self, t: float) -> None:
+        with self._lock:
+            self._last_ok_batch_t = t
+
+    def snapshot(self) -> tuple[float | None, float | None]:
+        """A consistent (last_quarantine_t, last_ok_batch_t) pair."""
+        with self._lock:
+            return self._last_quarantine_t, self._last_ok_batch_t
+
+    def recovered(self) -> bool:
+        """False while the most recent quarantine has not yet been
+        followed by a successful batch."""
+        lq, lok = self.snapshot()
+        return lq is None or (lok is not None and lok > lq)
 
 
 class ServingEngine:
@@ -132,7 +170,7 @@ class ServingEngine:
                 f"method must be 'greedy' or 'beam', got {method!r}"
             )
         if kv_mode is None:
-            kv_mode = os.environ.get("MLSPARK_SERVE_KV_MODE", "paged")
+            kv_mode = envcfg.get_str("MLSPARK_SERVE_KV_MODE")
         if kv_mode not in ("padded", "paged"):
             raise ValueError(
                 f"kv_mode must be 'padded' or 'paged', got {kv_mode!r} "
@@ -149,7 +187,7 @@ class ServingEngine:
         # flax cache has no scale plane), so those combinations fail
         # loudly instead of silently serving fp32.
         if kv_dtype is None:
-            kv_dtype = os.environ.get("MLSPARK_SERVE_KV_DTYPE", "float32")
+            kv_dtype = envcfg.get_str("MLSPARK_SERVE_KV_DTYPE")
         if kv_dtype not in ("float32", "int8"):
             raise ValueError(
                 f"kv_dtype must be 'float32' or 'int8', got {kv_dtype!r} "
@@ -244,10 +282,8 @@ class ServingEngine:
         # Health model for /healthz: the engine is DEGRADED while its most
         # recent quarantine is more recent than its most recent successful
         # batch — i.e. it has contained a fault and not yet proven it can
-        # decode again. Worker-thread writes, scrape-thread reads; float
-        # stores are atomic enough for a monotonic comparison.
-        self._last_quarantine_t: float | None = None
-        self._last_ok_batch_t: float | None = None
+        # decode again. Worker-thread writes, scrape-thread reads.
+        self._health = _HealthWindow()
 
     def _make_decoder(self, beam_size: int, length_penalty: float):
         """One jitted decode callable (its own jit cache → per-bucket
@@ -402,8 +438,7 @@ class ServingEngine:
         window between a quarantine and the next successful batch."""
         worker = self._worker
         worker_alive = worker is not None and worker.is_alive()
-        lq, lok = self._last_quarantine_t, self._last_ok_batch_t
-        recovered = lq is None or (lok is not None and lok > lq)
+        recovered = self._health.recovered()
         return {
             "healthy": worker_alive and recovered,
             "worker_alive": worker_alive,
@@ -642,7 +677,7 @@ class ServingEngine:
         )
         # A launch completed without raising: the degraded window (if
         # any) is over — /healthz flips back to ok.
-        self._last_ok_batch_t = decode_done
+        self._health.note_ok_batch(decode_done)
 
     def _paged_quarantine(self, exc: Exception) -> None:
         """Contain a failed launch/admission: the page store's contents
@@ -651,7 +686,7 @@ class ServingEngine:
         still queued keeps flowing."""
         if self._stop.is_set():
             return
-        self._last_quarantine_t = self.clock()
+        self._health.note_quarantine(self.clock())
         active = self.runtime.reset()
         log.info("quarantining paged launch of %d: %r", len(active), exc)
         telemetry.annotate(
@@ -706,7 +741,7 @@ class ServingEngine:
     def _quarantine(self, batch: Batch, exc: Exception) -> None:
         """Contain one failed batch: free its KV slots, fail its (and only
         its) requests with ``InternalError``, and count it."""
-        self._last_quarantine_t = self.clock()
+        self._health.note_quarantine(self.clock())
         log.info("quarantining batch of %d: %r", len(batch.requests), exc)
         telemetry.annotate(
             "serving.quarantine",
@@ -862,4 +897,4 @@ class ServingEngine:
             slot_occupancy=self.pool.occupancy,
         )
         # Batch retired cleanly: end of any post-quarantine degraded window.
-        self._last_ok_batch_t = decode_done
+        self._health.note_ok_batch(decode_done)

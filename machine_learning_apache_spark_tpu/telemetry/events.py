@@ -48,6 +48,11 @@ _DEFAULT_MAX_EVENTS = 65536
 def _env_rank() -> int | None:
     """This process's gang rank (``MLSPARK_PROCESS_ID``), or None outside
     a gang — same convention as ``utils.faults``."""
+    # Direct read by design: telemetry is stdlib-only by contract (module
+    # docstring); utils.env pulls the jax-importing utils package and a
+    # telemetry->utils import would also cycle through
+    # utils.profiling->telemetry.spans. Names stay registered.
+    # mlspark-lint: ok env-direct-read -- stdlib-only module, see above
     v = os.environ.get("MLSPARK_PROCESS_ID")
     try:
         return int(v) if v is not None else None
@@ -212,7 +217,7 @@ def enabled() -> bool:
     The env read is cached — instrumented hot paths pay one global load."""
     global _ENABLED
     if _ENABLED is None:
-        _ENABLED = os.environ.get(ENV_TELEMETRY, "1").strip().lower() not in (
+        _ENABLED = os.environ.get(ENV_TELEMETRY, "1").strip().lower() not in (  # mlspark-lint: ok env-direct-read -- stdlib-only module, see _env_rank
             "0", "false", "off", "no",
         )
     return _ENABLED
@@ -235,7 +240,7 @@ def get_log():
             if _LOG is None:
                 try:
                     max_events = int(
-                        os.environ.get(ENV_MAX_EVENTS, _DEFAULT_MAX_EVENTS)
+                        os.environ.get(ENV_MAX_EVENTS, _DEFAULT_MAX_EVENTS)  # mlspark-lint: ok env-direct-read -- stdlib-only module, see _env_rank
                     )
                 except ValueError:
                     max_events = _DEFAULT_MAX_EVENTS
@@ -257,7 +262,7 @@ def reset() -> None:
 def telemetry_dir() -> str | None:
     """Where rank exports and flight dumps land (``MLSPARK_TELEMETRY_DIR``);
     None means nothing is written to disk."""
-    return os.environ.get(ENV_TELEMETRY_DIR) or None
+    return os.environ.get(ENV_TELEMETRY_DIR) or None  # mlspark-lint: ok env-direct-read -- stdlib-only module, see _env_rank
 
 
 def annotate(name: str, **attrs) -> None:

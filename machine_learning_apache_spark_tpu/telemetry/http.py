@@ -61,7 +61,7 @@ FLIGHTZ_TAIL = 256
 SIDECAR_RE = re.compile(r"http_rank(\d+)\.json$")
 
 _STATE_LOCK = threading.Lock()
-_SERVER: "TelemetryHTTPServer | None" = None
+_SERVER: "TelemetryHTTPServer | None" = None  # guarded-by: _STATE_LOCK
 _STARTED_AT = time.monotonic()
 
 # Provider registries (shared across the process, like the metrics
@@ -69,9 +69,10 @@ _STARTED_AT = time.monotonic()
 # dict (one /statusz section each); health providers return a dict whose
 # "healthy" key drives the /healthz verdict; gauge providers return a float
 # sampled per /metrics scrape, keyed by full Prometheus metric name.
-_STATUS_PROVIDERS: dict[str, Callable[[], dict]] = {}
-_HEALTH_PROVIDERS: dict[str, Callable[[], dict]] = {}
-_GAUGE_PROVIDERS: dict[str, Callable[[], float]] = {}
+# Scrape paths copy the dict under the lock, then call providers unlocked.
+_STATUS_PROVIDERS: dict[str, Callable[[], dict]] = {}  # guarded-by: _STATE_LOCK
+_HEALTH_PROVIDERS: dict[str, Callable[[], dict]] = {}  # guarded-by: _STATE_LOCK
+_GAUGE_PROVIDERS: dict[str, Callable[[], float]] = {}  # guarded-by: _STATE_LOCK
 
 
 # -- provider registration -----------------------------------------------------
@@ -357,6 +358,9 @@ def find_port_sidecars(directory: str) -> dict[int, dict]:
 def http_port_from_env() -> int | None:
     """The configured port, or None when the plane is off (unset, empty,
     or unparseable ``MLSPARK_TELEMETRY_HTTP``)."""
+    # Direct read by design: telemetry is stdlib-only by contract;
+    # utils.env would cycle via utils.profiling (see events._env_rank).
+    # mlspark-lint: ok env-direct-read -- stdlib-only module, see above
     raw = os.environ.get(ENV_TELEMETRY_HTTP)
     if raw is None or not raw.strip():
         return None
@@ -388,10 +392,16 @@ def start_http_server(
         if _SERVER is not None:
             return _SERVER
         server = TelemetryHTTPServer(port=port).start()
+        # Sidecar before publication: once `_SERVER` is visible, a
+        # concurrent stop_http_server() may swap it out and call
+        # server.stop() — which unlinks `sidecar_path`. Assigning the
+        # sidecar after publishing leaves a window where stop() sees
+        # None and the file leaks past the server's death
+        # (tests/test_analysis_races.py races start/stop on this).
+        server.sidecar_path = write_port_sidecar(
+            server.port, directory=directory, rank=rank
+        )
         _SERVER = server
-    server.sidecar_path = write_port_sidecar(
-        server.port, directory=directory, rank=rank
-    )
     # The beacon carries the port so heartbeat payloads double as
     # discovery when no telemetry dir is configured.
     _events.beacon_update(http_port=server.port)
@@ -400,7 +410,8 @@ def start_http_server(
 
 
 def get_http_server() -> TelemetryHTTPServer | None:
-    return _SERVER
+    with _STATE_LOCK:
+        return _SERVER
 
 
 def stop_http_server() -> None:

@@ -90,6 +90,7 @@ def make_multi_step(loss_fn: LossFn):
 
 def make_eval_step(loss_fn: LossFn):
     @jax.jit
+    # mlspark-lint: ok jit-donate -- eval step: state is read, not updated; donating would consume the caller's buffers
     def step(state: TrainState, batch, rng: jax.Array):
         return loss_fn(state.params, batch, rng)
 

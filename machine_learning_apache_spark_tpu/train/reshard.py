@@ -57,6 +57,7 @@ from typing import Any, Iterable, Sequence
 
 import numpy as np
 
+from machine_learning_apache_spark_tpu.utils import env as envcfg
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -70,10 +71,7 @@ def resolve_elastic(elastic: bool | None) -> bool:
     every worker)."""
     if elastic is not None:
         return bool(elastic)
-    raw = os.environ.get(ENV_ELASTIC)
-    if raw is None:
-        return False
-    return raw.strip().lower() in ("1", "true", "on", "yes")
+    return envcfg.get_bool(ENV_ELASTIC)
 
 
 class TopologyMismatch(RuntimeError):

@@ -1,0 +1,482 @@
+"""Central ``MLSPARK_*`` environment contract — the env registry.
+
+Every environment variable the framework reads is declared here once,
+with its type, default, subsystem, and a one-line description. Runtime
+code resolves values through the typed accessors (``get_str`` /
+``get_int`` / ``get_float`` / ``get_bool``) instead of raw ``os.environ``
+reads, which buys three things:
+
+- **One contract.** ``docs/ENV.md`` is generated from this registry
+  (``tools/mlspark_lint.py --write-env-docs``) and the ``env`` lint pass
+  fails the build when docs and code drift, when an unregistered
+  ``MLSPARK_*`` name appears anywhere in the package, or when a module
+  bypasses the registry with a direct ``os.environ`` read.
+- **Typed, validated reads.** A malformed value raises one uniform
+  ``ValueError`` naming the variable and its expected type, instead of a
+  bare ``int()`` traceback deep inside a worker.
+- **Greppable writes.** The launcher's worker-env plumbing goes through
+  :func:`put_into`, so setting an unregistered name is an error at the
+  driver, not a silently ignored variable in every rank.
+
+The registry declarations are **pure literals** on purpose: the lint
+suite (``analysis/envcheck.py``) extracts them by AST without importing
+the package, so the contract is checkable without paying a JAX import.
+
+Stdlib-only module body; importable anywhere in the package. Note that
+importing it still triggers the package ``__init__`` — modules that must
+stay cheap *before* the heavy framework import (``launcher/runner.py``'s
+pre-import section) keep direct reads with a lint pragma instead.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Mapping, MutableMapping
+
+__all__ = [
+    "EnvVar",
+    "REGISTRY",
+    "register",
+    "lookup",
+    "registered_names",
+    "is_set",
+    "raw",
+    "get_str",
+    "get_int",
+    "get_float",
+    "get_bool",
+    "put_into",
+]
+
+_UNSET = object()
+
+#: Values ``get_bool`` reads as False; anything else set is True. Matches
+#: the historical ``MLSPARK_TELEMETRY=0`` semantics in telemetry.events.
+FALSY = ("0", "false", "off", "no", "")
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared environment variable: the contract row."""
+
+    name: str
+    type: str  # "str" | "int" | "float" | "bool" | "path" | "spec"
+    default: Any
+    subsystem: str
+    description: str
+    choices: tuple[str, ...] | None = None
+
+
+REGISTRY: dict[str, EnvVar] = {}
+
+
+def register(
+    name: str,
+    *,
+    type: str,
+    default: Any,
+    subsystem: str,
+    description: str,
+    choices: tuple[str, ...] | None = None,
+) -> EnvVar:
+    """Declare one variable. Names must be unique and ``MLSPARK_``-prefixed."""
+    if not name.startswith("MLSPARK_"):
+        raise ValueError(f"env contract covers MLSPARK_* names only, got {name!r}")
+    if name in REGISTRY:
+        raise ValueError(f"duplicate env registration: {name}")
+    if type not in ("str", "int", "float", "bool", "path", "spec"):
+        raise ValueError(f"{name}: unknown type {type!r}")
+    var = EnvVar(name, type, default, subsystem, description, choices)
+    REGISTRY[name] = var
+    return var
+
+
+# -- the contract ------------------------------------------------------------
+# Keep every field a literal: analysis/envcheck.py extracts these calls by
+# AST (no package import) to generate docs/ENV.md and to know the set of
+# legal names. Grouped by subsystem; docs render in this order.
+
+# core / platform bootstrap
+register(
+    "MLSPARK_PLATFORM", type="str", default=None, subsystem="core",
+    description="JAX platform override applied through the config API at "
+    "first package import (reliable where the JAX_PLATFORMS env var is "
+    "not, e.g. images whose sitecustomize pre-registers a TPU plugin). "
+    "Example: `cpu`, `tpu`.",
+)
+register(
+    "MLSPARK_CPU_DEVICES", type="int", default=None, subsystem="core",
+    description="Number of virtual CPU devices to request before backend "
+    "init (local mesh bring-up; the fake-cluster lever).",
+)
+register(
+    "MLSPARK_NO_NATIVE_TEXT", type="bool", default=False, subsystem="data",
+    description="Force the pure-Python tokenizer/vocab paths even when the "
+    "native extension is importable (bit-identical fallback; used by "
+    "parity tests).",
+)
+
+# session / train config (ConfigBase.from_env reads MLSPARK_<FIELD> for
+# every dataclass field; these are the fields that exist today)
+register(
+    "MLSPARK_APP_NAME", type="str", default="mlspark-tpu", subsystem="session",
+    description="Session app name (`spark.app.name` analogue; set by "
+    "`mlspark-submit --name`).",
+)
+register(
+    "MLSPARK_EXECUTOR_INSTANCES", type="int", default=0, subsystem="session",
+    description="Requested world size (`spark.executor.instances` "
+    "analogue). 0 derives from the JAX runtime.",
+)
+register(
+    "MLSPARK_EXECUTOR_CORES", type="int", default=1, subsystem="session",
+    description="Per-executor core request (SessionConfig field; "
+    "accounting only on TPU).",
+)
+register(
+    "MLSPARK_EXECUTOR_MEMORY", type="str", default="1g", subsystem="session",
+    description="Per-executor memory request (SessionConfig field; "
+    "accounting only on TPU).",
+)
+register(
+    "MLSPARK_DRIVER_MEMORY", type="str", default="1g", subsystem="session",
+    description="Driver memory request (SessionConfig field; accounting "
+    "only on TPU).",
+)
+register(
+    "MLSPARK_COORDINATOR_ADDRESS", type="str", default="", subsystem="session",
+    description="SessionConfig rendezvous override (`host:port`); the "
+    "launcher's MLSPARK_COORDINATOR is the usual channel.",
+)
+register(
+    "MLSPARK_COMPILATION_CACHE_DIR", type="path", default="", subsystem="session",
+    description="Persistent XLA compilation-cache directory (compiles "
+    "reused across processes; 20-60s/program on remote controllers).",
+)
+register(
+    "MLSPARK_BATCH_SIZE", type="int", default=32, subsystem="train",
+    description="TrainConfig.batch_size override (recipe hyperparameter).",
+)
+register(
+    "MLSPARK_EPOCHS", type="int", default=3, subsystem="train",
+    description="TrainConfig.epochs override (recipe hyperparameter).",
+)
+register(
+    "MLSPARK_LEARNING_RATE", type="float", default=1e-3, subsystem="train",
+    description="TrainConfig.learning_rate override (recipe hyperparameter).",
+)
+register(
+    "MLSPARK_OPTIMIZER", type="str", default="adam", subsystem="train",
+    description="TrainConfig.optimizer override.", choices=("adam", "sgd"),
+)
+register(
+    "MLSPARK_SEED", type="int", default=1234, subsystem="train",
+    description="TrainConfig.seed override (PRNG seed for the recipes).",
+)
+register(
+    "MLSPARK_LOG_EVERY", type="int", default=100, subsystem="train",
+    description="TrainConfig.log_every override (per-N-batch print cadence).",
+)
+register(
+    "MLSPARK_DTYPE", type="str", default="float32", subsystem="train",
+    description="TrainConfig.dtype override (compute dtype; `bfloat16` "
+    "for MXU-friendly runs).",
+)
+
+# launcher / rendezvous / gang liveness
+register(
+    "MLSPARK_COORDINATOR", type="str", default=None, subsystem="launcher",
+    description="Rendezvous coordinator `host:port` the launcher writes "
+    "into every worker (maps onto jax.distributed.initialize; "
+    "MASTER_ADDR/MASTER_PORT are the torch-style aliases).",
+)
+register(
+    "MLSPARK_NUM_PROCESSES", type="int", default=1, subsystem="launcher",
+    description="Gang world size as this worker sees it (WORLD_SIZE "
+    "analogue; shrinks under elastic resume).",
+)
+register(
+    "MLSPARK_PROCESS_ID", type="int", default=0, subsystem="launcher",
+    description="This worker's gang rank (RANK analogue); also the rank "
+    "label telemetry and fault plans key on.",
+)
+register(
+    "MLSPARK_GANG_ATTEMPT", type="int", default=0, subsystem="launcher",
+    description="Which all-or-nothing gang restart attempt this worker "
+    "belongs to (0 on the first launch).",
+)
+register(
+    "MLSPARK_HEARTBEAT_FILE", type="path", default=None, subsystem="launcher",
+    description="Per-rank heartbeat file the worker rewrites every "
+    "interval; the GangMonitor's liveness signal (mtime) and "
+    "gang-status payload (JSON content).",
+)
+register(
+    "MLSPARK_HEARTBEAT_INTERVAL", type="float", default=1.0, subsystem="launcher",
+    description="Seconds between heartbeat rewrites.",
+)
+register(
+    "MLSPARK_ELASTIC", type="bool", default=False, subsystem="launcher",
+    description="Set by Distributor(elastic=True): workers' fit() "
+    "reshards old-topology checkpoints onto a shrunken mesh instead of "
+    "refusing them (train/reshard.py).",
+)
+
+# parallel / comms
+register(
+    "MLSPARK_DP_MODE", type="str", default="replicated", subsystem="parallel",
+    description="Data-parallel update mode for fit() when dp_mode= is not "
+    "passed.", choices=("replicated", "zero1"),
+)
+register(
+    "MLSPARK_ZERO1_BUCKET_BYTES", type="int", default=4194304, subsystem="parallel",
+    description="ZeRO-1 bucket size in bytes (the comm/compute overlap "
+    "pipeline grain).",
+)
+register(
+    "MLSPARK_ZERO1_OVERLAP", type="bool", default=True, subsystem="parallel",
+    description="Per-bucket update/allgather overlap schedule on (default) "
+    "or off (serial reference path; bit-identical either way).",
+)
+register(
+    "MLSPARK_COMMS_DTYPE", type="str", default="float32", subsystem="parallel",
+    description="ZeRO-1 wire dtype for reduce-scatter/allgather "
+    "(sub-fp32 shrinks bytes; int8 uses EQuARX-style per-bucket scales).",
+    choices=("float32", "bfloat16", "int8"),
+)
+
+# serving
+register(
+    "MLSPARK_SERVE_KV_MODE", type="str", default="paged", subsystem="serving",
+    description="KV-cache discipline for ServingEngine when kv_mode= is "
+    "not passed: `paged` (ragged paged attention, the default) or "
+    "`padded` (per-bucket rectangle oracle / beam path).",
+    choices=("padded", "paged"),
+)
+register(
+    "MLSPARK_SERVE_KV_DTYPE", type="str", default="float32", subsystem="serving",
+    description="Paged KV store dtype: `float32`, or `int8` with "
+    "per-page scales (paged+greedy only; padded/beam engines reject it).",
+    choices=("float32", "int8"),
+)
+
+# telemetry / observability plane
+register(
+    "MLSPARK_TELEMETRY", type="bool", default=True, subsystem="telemetry",
+    description="Master switch; `0` makes every telemetry entry point a "
+    "no-op singleton (zero cost, zero threads).",
+)
+register(
+    "MLSPARK_TELEMETRY_DIR", type="path", default=None, subsystem="telemetry",
+    description="Where rank JSONL exports, flight dumps, and port "
+    "sidecars land; unset means no file exports.",
+)
+register(
+    "MLSPARK_TELEMETRY_HTTP", type="int", default=None, subsystem="telemetry",
+    description="Port for the per-process observability HTTP server "
+    "(/metrics, /healthz, /statusz, /flightz); 0 = ephemeral; unset = no "
+    "server, zero threads.",
+)
+register(
+    "MLSPARK_TELEMETRY_EVENTS", type="int", default=4096, subsystem="telemetry",
+    description="Flight-recorder event-ring capacity (events kept for "
+    "/flightz and crash dumps).",
+)
+
+# ingest
+register(
+    "MLSPARK_INGEST_BUFFER", type="int", default=2, subsystem="ingest",
+    description="Host-side prefetch depth in batches (0 = synchronous "
+    "batch assembly).",
+)
+register(
+    "MLSPARK_INGEST_DEVICE_PREFETCH", type="int", default=2, subsystem="ingest",
+    description="Batches kept resident on-device ahead of consumption "
+    "(double buffering at 2; 0 disables the device stage).",
+)
+register(
+    "MLSPARK_INGEST_TAIL", type="str", default="pad", subsystem="ingest",
+    description="Epoch-tail policy: `pad` (collective-safe wrap-pad) or "
+    "`drop`.", choices=("pad", "drop"),
+)
+register(
+    "MLSPARK_INGEST_CHUNK_LINES", type="int", default=1024, subsystem="ingest",
+    description="Lines per parser call in the streaming file readers "
+    "(native-parser batching grain).",
+)
+
+# fleet / multi-replica serving
+register(
+    "MLSPARK_FLEET_DIR", type="path", default=None, subsystem="fleet",
+    description="Where fleet sidecars (`fleet_rank<k>.json`) and the "
+    "`fleet_stop` marker live; defaults to the telemetry dir.",
+)
+register(
+    "MLSPARK_FLEET_PORT", type="int", default=0, subsystem="fleet",
+    description="Replica data-plane port (0 = ephemeral, the only sane "
+    "choice for a local gang).",
+)
+register(
+    "MLSPARK_FLEET_POLICY", type="str", default="affinity", subsystem="fleet",
+    description="Router dispatch policy when policy= is not passed.",
+    choices=("round_robin", "least_loaded", "affinity"),
+)
+register(
+    "MLSPARK_FLEET_SCRAPE_INTERVAL", type="float", default=0.5, subsystem="fleet",
+    description="Router scrape-loop period in seconds (replica /statusz "
+    "polling).",
+)
+register(
+    "MLSPARK_FLEET_TENANT_MAX_IN_FLIGHT", type="int", default=None, subsystem="fleet",
+    description="Per-tenant in-flight admission quota (unset = no tenant "
+    "quota).",
+)
+register(
+    "MLSPARK_FLEET_INTERACTIVE_DEADLINE_S", type="float", default=10.0, subsystem="fleet",
+    description="Default deadline for the `interactive` SLO tier.",
+)
+register(
+    "MLSPARK_FLEET_INTERACTIVE_MAX_IN_FLIGHT", type="int", default=64, subsystem="fleet",
+    description="In-flight cap for the `interactive` SLO tier.",
+)
+register(
+    "MLSPARK_FLEET_BATCH_DEADLINE_S", type="float", default=120.0, subsystem="fleet",
+    description="Default deadline for the `batch` SLO tier.",
+)
+register(
+    "MLSPARK_FLEET_BATCH_MAX_IN_FLIGHT", type="int", default=256, subsystem="fleet",
+    description="In-flight cap for the `batch` SLO tier.",
+)
+
+# fault injection
+register(
+    "MLSPARK_FAULTS", type="spec", default=None, subsystem="faults",
+    description="Fault-injection plan (semicolon-separated grammar, see "
+    "utils/faults.py): which site fails, on which rank/world/occurrence, "
+    "and how.",
+)
+register(
+    "MLSPARK_FAULTS_DIR", type="path", default=None, subsystem="faults",
+    description="Where fault-marker files are written (evidence that an "
+    "injected fault fired, robust to the process dying mid-action).",
+)
+
+# examples / demo scripts (read only by examples/, registered so the
+# contract and docs cover them)
+register(
+    "MLSPARK_SMOKE", type="bool", default=False, subsystem="examples",
+    description="Shrink example model/data for a quick CPU check "
+    "(examples/advanced_translator.py).",
+)
+register(
+    "MLSPARK_WORKDIR", type="path", default=None, subsystem="examples",
+    description="Example scripts' scratch directory (default: a fresh "
+    "tempdir).",
+)
+register(
+    "MLSPARK_DIST_PLATFORM", type="str", default="cpu", subsystem="examples",
+    description="Platform the distributed example scripts pass to "
+    "Distributor(platform=...); empty = let each worker pick.",
+)
+
+
+# -- typed accessors ----------------------------------------------------------
+def lookup(name: str) -> EnvVar:
+    """The declaration for ``name``; raises ``KeyError`` with the fix for
+    unregistered names (the runtime mirror of the lint rule)."""
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name} is not in the MLSPARK_* env contract; declare it in "
+            "machine_learning_apache_spark_tpu/utils/env.py (and regenerate "
+            "docs/ENV.md with tools/mlspark_lint.py --write-env-docs)"
+        ) from None
+
+
+def registered_names() -> frozenset[str]:
+    return frozenset(REGISTRY)
+
+
+def raw(name: str, environ: Mapping[str, str] | None = None) -> str | None:
+    """The unparsed value, or None when unset. Registry-checked."""
+    lookup(name)
+    env = os.environ if environ is None else environ
+    return env.get(name)
+
+
+def is_set(name: str, environ: Mapping[str, str] | None = None) -> bool:
+    return raw(name, environ) is not None
+
+
+def _resolve_default(var: EnvVar, default: Any) -> Any:
+    return var.default if default is _UNSET else default
+
+
+def get_str(
+    name: str, default: Any = _UNSET, environ: Mapping[str, str] | None = None
+) -> str | None:
+    var = lookup(name)
+    v = raw(name, environ)
+    if v is None:
+        return _resolve_default(var, default)
+    if var.choices is not None and v not in var.choices:
+        raise ValueError(
+            f"{name} must be one of {list(var.choices)}, got {v!r}"
+        )
+    return v
+
+
+def get_int(
+    name: str, default: Any = _UNSET, environ: Mapping[str, str] | None = None
+) -> int | None:
+    var = lookup(name)
+    v = raw(name, environ)
+    if v is None:
+        return _resolve_default(var, default)
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {v!r}") from None
+
+
+def get_float(
+    name: str, default: Any = _UNSET, environ: Mapping[str, str] | None = None
+) -> float | None:
+    var = lookup(name)
+    v = raw(name, environ)
+    if v is None:
+        return _resolve_default(var, default)
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name} must be a float, got {v!r}") from None
+
+
+def get_bool(
+    name: str, default: Any = _UNSET, environ: Mapping[str, str] | None = None
+) -> bool:
+    """Truthy unless the value is one of :data:`FALSY` (case-insensitive);
+    unset resolves the default."""
+    var = lookup(name)
+    v = raw(name, environ)
+    if v is None:
+        return bool(_resolve_default(var, default))
+    return v.strip().lower() not in FALSY
+
+
+def put_into(
+    env: MutableMapping[str, str], name: str, value: Any
+) -> MutableMapping[str, str]:
+    """Write one contract variable into a (worker) env mapping — the
+    launcher-side half of the contract. Registry-checked so a typo'd name
+    fails at the driver, not as a silently ignored variable in the gang."""
+    var = lookup(name)
+    s = str(value)
+    if var.choices is not None and s not in var.choices:
+        raise ValueError(
+            f"{name} must be one of {list(var.choices)}, got {value!r}"
+        )
+    env[name] = s
+    return env

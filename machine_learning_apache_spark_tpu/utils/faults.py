@@ -139,10 +139,17 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls, environ=os.environ) -> "FaultPlan | None":
+        # Direct read by design: must stay stdlib-importable pre-platform
+        # (see _log); utils.env pulls the jax-importing utils package.
+        # Names ARE registered; only the accessor differs.
+        # mlspark-lint: ok env-direct-read -- pre-platform module, see above
         text = environ.get(ENV_PLAN)
         if not text:
             return None
-        plan = cls.from_spec(text, marker_dir=environ.get(ENV_MARKER_DIR))
+        plan = cls.from_spec(
+            text,
+            marker_dir=environ.get(ENV_MARKER_DIR),  # mlspark-lint: ok env-direct-read -- pre-platform module, see from_env
+        )
         if plan.marker_dir is None and any(
             s.action in ("crash", "stall") for s in plan.specs
         ):
@@ -227,11 +234,13 @@ def heartbeats_suspended() -> bool:
 
 
 def _env_rank() -> int | None:
+    # mlspark-lint: ok env-direct-read -- pre-platform module, see from_env
     v = os.environ.get("MLSPARK_PROCESS_ID")
     return int(v) if v is not None else None
 
 
 def _env_world() -> int | None:
+    # mlspark-lint: ok env-direct-read -- pre-platform module, see from_env
     v = os.environ.get("MLSPARK_NUM_PROCESSES")
     return int(v) if v is not None else None
 

@@ -1,0 +1,185 @@
+"""Races the ``locks`` lint pass flagged, pinned under real thread load.
+
+The static pass (docs/STATIC_ANALYSIS.md) found two quarantine-adjacent
+races when the ``# guarded-by:`` declarations went in:
+
+- ``serving.engine``: the /healthz window timestamps were written by the
+  decode worker and read pairwise by scrape threads with two bare loads —
+  a reader could pair a fresh ok-batch time with a stale quarantine time
+  and report "recovered" mid-degraded-window. Fixed by ``_HealthWindow``
+  (both fields guarded by one lock, snapshot under it).
+- ``telemetry.http``: ``start_http_server`` published ``_SERVER`` and
+  released the lock *before* assigning ``sidecar_path``, so a concurrent
+  ``stop_http_server`` could retire the server while its sidecar write
+  was still in flight — leaking an ``http_rank<k>.json`` past the
+  server's death. Fixed by writing the sidecar before publication,
+  inside the lock.
+
+Each test here drives the fixed code from 4 threads and asserts the
+invariant the race used to break.
+"""
+
+import glob
+import os
+import threading
+import time
+
+import pytest
+
+from machine_learning_apache_spark_tpu import telemetry
+from machine_learning_apache_spark_tpu.telemetry import events, http
+
+STRESS_SECONDS = 0.4
+
+
+# -- serving: the /healthz quarantine window -----------------------------------
+@pytest.mark.serving
+class TestHealthWindow:
+    def test_recovered_semantics(self):
+        from machine_learning_apache_spark_tpu.serving.engine import (
+            _HealthWindow,
+        )
+
+        w = _HealthWindow()
+        assert w.recovered()  # never quarantined
+        w.note_quarantine(1.0)
+        assert not w.recovered()  # degraded until a batch lands
+        w.note_ok_batch(2.0)
+        assert w.recovered()
+        w.note_quarantine(3.0)
+        assert not w.recovered()  # re-quarantined after the ok batch
+        assert w.snapshot() == (3.0, 2.0)
+
+    def test_snapshot_pair_is_consistent_under_4_threads(self):
+        """1 writer + 3 readers. The writer advances in lockstep pairs
+        (quarantine at i, then ok-batch at i), so at every instant the
+        true state satisfies ``lq - 1 <= lok <= lq``. A torn pair read
+        observes ``lok > lq`` (stale quarantine + fresh ok) and falsely
+        reports recovery — possible whenever the two loads can be split
+        by a thread switch, which the lock rules out structurally rather
+        than leaving to CPython's bytecode-level switch points."""
+        from machine_learning_apache_spark_tpu.serving.engine import (
+            _HealthWindow,
+        )
+
+        w = _HealthWindow()
+        stop = threading.Event()
+        violations: list[tuple] = []
+
+        def writer():
+            i = 0.0
+            while not stop.is_set():
+                i += 1.0
+                w.note_quarantine(i)
+                w.note_ok_batch(i)
+
+        def reader():
+            while not stop.is_set():
+                lq, lok = w.snapshot()
+                if lq is None:
+                    if lok is not None:
+                        violations.append((lq, lok))
+                elif lok is not None and not (lq - 1.0 <= lok <= lq):
+                    violations.append((lq, lok))
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(STRESS_SECONDS)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not violations, violations[:5]
+
+
+# -- telemetry: server publication vs. sidecar ---------------------------------
+@pytest.mark.telemetry
+class TestHttpServerRaces:
+    @pytest.fixture(autouse=True)
+    def fresh(self, monkeypatch):
+        monkeypatch.delenv(events.ENV_TELEMETRY, raising=False)
+        monkeypatch.delenv(events.ENV_TELEMETRY_DIR, raising=False)
+        monkeypatch.delenv(http.ENV_TELEMETRY_HTTP, raising=False)
+        telemetry.reset()
+        yield
+        telemetry.reset()
+
+    def test_concurrent_starts_yield_one_server(self, tmp_path):
+        barrier = threading.Barrier(4)
+        results: list = [None] * 4
+
+        def start(k):
+            barrier.wait()
+            results[k] = http.start_http_server(
+                0, directory=str(tmp_path)
+            )
+
+        threads = [
+            threading.Thread(target=start, args=(k,)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(r is not None for r in results)
+        assert len({id(r) for r in results}) == 1
+        assert http.get_http_server() is results[0]
+        http.stop_http_server()
+
+    def test_start_stop_race_never_leaks_a_sidecar(
+        self, tmp_path, monkeypatch
+    ):
+        """2 starters vs. 2 stoppers, with the sidecar write slowed to
+        model a stalled telemetry dir (NFS, overloaded disk). Pre-fix,
+        the server was published before its sidecar write: a stop could
+        swap it out and finish while ``sidecar_path`` was still None,
+        after which the write landed an ``http_rank<k>.json`` no stop
+        would ever unlink. Distinct ranks per start keep a leaked
+        sidecar visible instead of letting the next server overwrite
+        (then retract) the same filename."""
+        real_write = http.write_port_sidecar
+
+        def slow_write(*args, **kwargs):
+            time.sleep(0.75)  # > stop()'s serve_forever poll interval
+            return real_write(*args, **kwargs)
+
+        monkeypatch.setattr(http, "write_port_sidecar", slow_write)
+        rank_counter = iter(range(10_000))
+
+        for _ in range(2):
+            barrier = threading.Barrier(4)
+            starters_done = threading.Event()
+
+            def start():
+                rank = next(rank_counter)
+                barrier.wait()
+                http.start_http_server(
+                    0, directory=str(tmp_path), rank=rank
+                )
+
+            def stop():
+                # hammer stop until the starters are through: one of
+                # these calls lands inside start's publication window
+                barrier.wait()
+                while not starters_done.is_set():
+                    http.stop_http_server()
+
+            starters = [threading.Thread(target=start) for _ in range(2)]
+            threads = starters + [
+                threading.Thread(target=stop) for _ in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in starters:
+                t.join(timeout=30)
+            starters_done.set()
+            for t in threads:
+                t.join(timeout=30)
+            # retire whichever server survived the race, then nothing may
+            # remain: every created server's sidecar dies with it
+            http.stop_http_server()
+            assert http.get_http_server() is None
+            leaked = glob.glob(os.path.join(str(tmp_path), "http_rank*"))
+            assert not leaked, leaked
