@@ -146,7 +146,8 @@ class Module:
 @dataclass
 class LintConfig:
     passes: list[str] = field(
-        default_factory=lambda: ["recompile", "locks", "env", "jit"]
+        default_factory=lambda: ["recompile", "locks", "env", "jit",
+                                 "trace"]
     )
     exclude: list[str] = field(default_factory=list)
     env_registry: str = "machine_learning_apache_spark_tpu/utils/env.py"

@@ -20,6 +20,7 @@ from machine_learning_apache_spark_tpu.analysis.locks import run_locks
 from machine_learning_apache_spark_tpu.analysis.recompile import (
     run_recompile,
 )
+from machine_learning_apache_spark_tpu.analysis.tracecheck import run_trace
 
 __all__ = ["PASSES", "run_lint"]
 
@@ -28,6 +29,7 @@ PASSES = {
     "locks": run_locks,
     "env": run_env,
     "jit": run_jit,
+    "trace": run_trace,
 }
 
 

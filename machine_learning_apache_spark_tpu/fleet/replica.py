@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -40,6 +41,10 @@ from machine_learning_apache_spark_tpu.serving.queue import (
 )
 from machine_learning_apache_spark_tpu.telemetry import events as _events
 from machine_learning_apache_spark_tpu.telemetry import http as _thttp
+from machine_learning_apache_spark_tpu.telemetry import spans as _spans
+from machine_learning_apache_spark_tpu.telemetry import (
+    tracectx as _tracectx,
+)
 from machine_learning_apache_spark_tpu.utils import env as envcfg
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
@@ -114,6 +119,7 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
             deadline_s=body.get("deadline_s"),
             tier=body.get("tier"),
             tenant=body.get("tenant"),
+            traceparent=self.headers.get("traceparent"),
         )
         headers = {}
         if code == 429 and payload.get("retry_after") is not None:
@@ -133,6 +139,11 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 self._reply(200 if healthy else 503, payload)
             elif self.path.startswith("/flightz"):
                 self._reply(200, _thttp.flightz())
+            elif self.path.startswith("/tracez"):
+                m = re.search(r"(?:^|[?&])id=([0-9a-fA-F]+)", self.path)
+                self._reply(
+                    200, _thttp.tracez(m.group(1).lower() if m else None)
+                )
             elif self.path.startswith("/statusz") or self.path == "/":
                 self._reply(200, _thttp.statusz())
             else:
@@ -250,6 +261,31 @@ class ReplicaServer:
         deadline_s: float | None = None,
         tier: str | None = None,
         tenant: str | None = None,
+        traceparent: str | None = None,
+    ) -> tuple[int, dict]:
+        """One routed request, handler thread. The router's traceparent
+        header (when present and well-formed) re-activates its trace on
+        this thread for the whole replica-side lifetime: the
+        ``fleet.replica`` span records this hop (``remote_parent`` is
+        the router attempt's span id — the cross-process edge
+        ``traceview`` draws a flow arrow over), and the engine adopts
+        the context at submit so the queue/decode spans stitch in."""
+        ctx = _tracectx.parse_traceparent(traceparent)
+        attrs = {"rank": self.rank, "tier": tier}
+        if ctx is not None:
+            attrs["remote_parent"] = ctx.span_id
+        with _tracectx.use(ctx), _spans.span("fleet.replica", **attrs):
+            return self._generate_inner(
+                text, deadline_s=deadline_s, tier=tier, tenant=tenant
+            )
+
+    def _generate_inner(
+        self,
+        text: str,
+        *,
+        deadline_s: float | None,
+        tier: str | None,
+        tenant: str | None,
     ) -> tuple[int, dict]:
         with self._lock:
             self.requests += 1
@@ -264,7 +300,7 @@ class ReplicaServer:
                 "rank": self.rank,
             }
         try:
-            req = self.engine.submit(text, deadline_s=deadline_s)
+            req = self.engine.submit(text, deadline_s=deadline_s, tier=tier)
         except Backpressure as e:
             with self._lock:
                 self.rejected += 1

@@ -49,10 +49,16 @@ from machine_learning_apache_spark_tpu.fleet.affinity import AffinityTable
 from machine_learning_apache_spark_tpu.fleet.scrape import (
     ReplicaSnapshot,
     ScrapeLoop,
+    fleet_slo_rollup,
 )
+from machine_learning_apache_spark_tpu.serving.metrics import BurnRate
 from machine_learning_apache_spark_tpu.telemetry import events as _events
 from machine_learning_apache_spark_tpu.telemetry import (
     registry as _registry,
+)
+from machine_learning_apache_spark_tpu.telemetry import spans as _spans
+from machine_learning_apache_spark_tpu.telemetry import (
+    tracectx as _tracectx,
 )
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
@@ -133,19 +139,25 @@ class ReplicaClient:
         tier: str,
         tenant: str | None,
         timeout: float,
+        traceparent: str | None = None,
     ) -> tuple[str, int | None, dict]:
         """Returns ``(kind, http_status, payload)`` with kind in
-        {"ok", "refused", "backpressure", "failed", "lost"}."""
+        {"ok", "refused", "backpressure", "failed", "lost"}.
+        ``traceparent`` (when tracing is on and the request was sampled)
+        rides as the W3C header so the replica joins the trace."""
         body = json.dumps({
             "text": text,
             "deadline_s": deadline_s,
             "tier": tier,
             "tenant": tenant,
         }).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        if traceparent is not None:
+            headers["traceparent"] = traceparent
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/v1/generate",
             data=body,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST",
         )
         try:
@@ -243,6 +255,11 @@ class FleetRouter:
         self.failed = 0        # dispatched and lost / decode failure
         self.retries = 0
         self._per_replica: dict[int, dict] = {}
+        # Per-tier SLO burn gauges over *routed* outcomes: a request
+        # "missed" unless it completed within its deadline — rejected,
+        # unavailable, and failed dispatches all burn budget, because the
+        # client's SLO does not care which layer dropped the ball.
+        self._burn: dict[str, BurnRate] = {}
         self._reg = _registry.get_registry()
         self._counters = {
             name: self._reg.counter("fleet", name)
@@ -313,7 +330,15 @@ class FleetRouter:
         payload. Raises :class:`FleetBackpressure` (whole fleet at
         capacity / quota exhausted), :class:`FleetUnavailable` (no
         healthy replica), :class:`FleetRequestFailed` (dispatched and
-        lost or decode-failed — the non-retried taxonomy)."""
+        lost or decode-failed — the non-retried taxonomy).
+
+        Distributed tracing: the router is where a request's trace is
+        **minted** (head-sampled once, here). The whole dispatch lives
+        under a ``fleet.submit`` span; each dispatch attempt gets a
+        ``fleet.attempt`` child span and a fresh child span id sent as
+        the ``traceparent`` header — so a 503-drained attempt and its
+        successful retry land as siblings under one trace, each joined
+        to its replica-side spans by a distinct cross-process edge."""
         t0 = self.clock()
         self._bump("submitted")
         try:
@@ -321,97 +346,130 @@ class FleetRouter:
         except FleetBackpressure:
             self._bump("rejected")
             raise
+        ctx = _tracectx.mint()
         digest = None
         retries = 0
         outcome, out_rank, status = "failed", None, None
-        try:
-            if self.key_fn is not None:
-                try:
-                    digest = self.key_fn(text)
-                except Exception:
-                    digest = None
-            deadline = deadline_s if deadline_s is not None else lease.deadline_s
-            tried: set[int] = set()
-            backpressure: FleetBackpressure | None = None
-            while True:
-                snaps = self._usable_snapshots()
-                rank = pick_replica(
-                    snaps,
-                    policy=self.policy,
-                    candidates=self.affinity.candidates(digest),
-                    exclude=tried,
-                    rr_state=self._rr,
-                )
-                if rank is None:
-                    if backpressure is not None:
-                        outcome = "rejected"
-                        self._bump("rejected")
-                        raise backpressure
-                    outcome = "unavailable"
-                    self._bump("unavailable")
-                    raise FleetUnavailable(
-                        f"no healthy replica (tried {sorted(tried)})"
+        deadline = deadline_s if deadline_s is not None else lease.deadline_s
+        with _tracectx.use(ctx), _spans.span("fleet.submit", tier=tier):
+            try:
+                if self.key_fn is not None:
+                    try:
+                        digest = self.key_fn(text)
+                    except Exception:
+                        digest = None
+                tried: set[int] = set()
+                backpressure: FleetBackpressure | None = None
+                while True:
+                    snaps = self._usable_snapshots()
+                    rank = pick_replica(
+                        snaps,
+                        policy=self.policy,
+                        candidates=self.affinity.candidates(digest),
+                        exclude=tried,
+                        rr_state=self._rr,
                     )
-                tried.add(rank)
-                snap = snaps[rank]
-                self._note(rank, "dispatched")
-                kind, status, payload = ReplicaClient.generate(
-                    snap.port, text,
-                    deadline_s=deadline, tier=tier, tenant=tenant,
-                    timeout=min(self.request_timeout_s,
-                                deadline + 30.0),
-                )
-                if kind == "ok":
-                    self.affinity.note_routed(digest, rank)
-                    self._note(rank, "completed")
-                    outcome, out_rank = "completed", rank
-                    self._bump("completed")
-                    return payload
-                if kind == "refused":
-                    # 503 / connection refused: never entered the queue.
-                    # Box the rank (scrape recovery lets it back) and
-                    # drain to the next-best replica.
-                    self._box(rank)
-                    self.affinity.forget_rank(rank)
-                    self._note(rank, "refused")
-                    retries += 1
-                    self._bump("retries")
-                    continue
-                if kind == "backpressure":
-                    self._note(rank, "backpressure")
-                    ra = (payload or {}).get("retry_after") or 0.05
-                    if backpressure is None or ra > backpressure.retry_after:
-                        backpressure = FleetBackpressure(
-                            (payload or {}).get("depth", 0), ra,
-                            scope=f"replica:{rank}",
+                    if rank is None:
+                        if backpressure is not None:
+                            outcome = "rejected"
+                            self._bump("rejected")
+                            raise backpressure
+                        outcome = "unavailable"
+                        self._bump("unavailable")
+                        raise FleetUnavailable(
+                            f"no healthy replica (tried {sorted(tried)})"
                         )
-                    retries += 1
-                    self._bump("retries")
-                    continue
-                # "lost" or "failed": terminal, not retried.
-                self._note(rank, "lost" if kind == "lost" else "failed")
-                outcome, out_rank = kind, rank
-                self._bump("failed")
-                if kind == "lost":
-                    # The socket died under a dispatched request — treat
-                    # the rank as down for new traffic too.
-                    self._box(rank)
-                raise FleetRequestFailed(
-                    f"request {kind} on replica {rank} "
-                    f"(status={status}): {(payload or {}).get('error')}",
-                    rank=rank, status=status,
+                    tried.add(rank)
+                    snap = snaps[rank]
+                    self._note(rank, "dispatched")
+                    # One child span id per attempt: the replica records
+                    # it as remote_parent, which is how the merged view
+                    # attaches each replica's spans to the right attempt.
+                    attempt = _tracectx.child(ctx)
+                    attempt_attrs = {"replica": rank}
+                    if attempt is not None:
+                        attempt_attrs["ctx_span"] = attempt.span_id
+                    with _spans.span("fleet.attempt", **attempt_attrs):
+                        kind, status, payload = ReplicaClient.generate(
+                            snap.port, text,
+                            deadline_s=deadline, tier=tier, tenant=tenant,
+                            timeout=min(self.request_timeout_s,
+                                        deadline + 30.0),
+                            traceparent=(
+                                None if attempt is None
+                                else _tracectx.to_traceparent(attempt)
+                            ),
+                        )
+                    if kind == "ok":
+                        self.affinity.note_routed(digest, rank)
+                        self._note(rank, "completed")
+                        outcome, out_rank = "completed", rank
+                        self._bump("completed")
+                        return payload
+                    if kind == "refused":
+                        # 503 / connection refused: never entered the
+                        # queue. Box the rank (scrape recovery lets it
+                        # back) and drain to the next-best replica.
+                        self._box(rank)
+                        self.affinity.forget_rank(rank)
+                        self._note(rank, "refused")
+                        retries += 1
+                        self._bump("retries")
+                        continue
+                    if kind == "backpressure":
+                        self._note(rank, "backpressure")
+                        ra = (payload or {}).get("retry_after") or 0.05
+                        if backpressure is None or ra > backpressure.retry_after:
+                            backpressure = FleetBackpressure(
+                                (payload or {}).get("depth", 0), ra,
+                                scope=f"replica:{rank}",
+                            )
+                        retries += 1
+                        self._bump("retries")
+                        continue
+                    # "lost" or "failed": terminal, not retried.
+                    self._note(rank, "lost" if kind == "lost" else "failed")
+                    outcome, out_rank = kind, rank
+                    self._bump("failed")
+                    if kind == "lost":
+                        # The socket died under a dispatched request —
+                        # treat the rank as down for new traffic too.
+                        self._box(rank)
+                    raise FleetRequestFailed(
+                        f"request {kind} on replica {rank} "
+                        f"(status={status}): {(payload or {}).get('error')}",
+                        rank=rank, status=status,
+                    )
+            finally:
+                total = self.clock() - t0
+                self.admission.release(lease, service_s=total)
+                self._observe_slo(
+                    tier, outcome != "completed" or total > deadline
                 )
-        finally:
-            total = self.clock() - t0
-            self.admission.release(lease, service_s=total)
-            _events.annotate(
-                "fleet.request",
-                outcome=outcome, replica=out_rank, tier=tier,
-                tenant=tenant, retries=retries, total_s=round(total, 6),
-                status=status,
-            )
+                _events.annotate(
+                    "fleet.request",
+                    outcome=outcome, replica=out_rank, tier=tier,
+                    tenant=tenant, retries=retries, total_s=round(total, 6),
+                    status=status,
+                )
 
     # -- accounting ----------------------------------------------------------
+    def _observe_slo(self, tier: str, missed: bool) -> None:
+        """Fold one request outcome into the router-side burn gauge for
+        its tier. Router semantics are stricter than the replica's: a
+        request burns budget unless it **completed within deadline** —
+        rejections, unavailability, and failed dispatches all count,
+        because the client experienced a miss either way."""
+        tier = tier or "interactive"
+        with self._lock:
+            burn = self._burn.get(tier)
+            if burn is None:
+                burn = self._burn[tier] = BurnRate()
+        burn.observe(missed)
+        _registry.get_registry().gauge(
+            "fleet", f"slo_burn_{tier}"
+        ).set(burn.ewma)
+
     def _bump(self, name: str) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + 1)
@@ -455,12 +513,19 @@ class FleetRouter:
         with self._lock:
             per_replica = {r: dict(v) for r, v in self._per_replica.items()}
             down = sorted(self._down)
+            slo = {tier: b.snapshot() for tier, b in sorted(self._burn.items())}
         return {
             "policy": self.policy,
             "ledger": self.ledger(),
             "retries": self.retries,
             "per_replica": per_replica,
             "down": down,
+            # Router-observed burn (every routed outcome) next to the
+            # scrape-side rollup of what each replica's engine saw —
+            # disagreement between the two is itself a signal (e.g. the
+            # router burning on "unavailable" while replicas look clean).
+            "slo": slo,
+            "slo_fleet": fleet_slo_rollup(self._snapshot_source()),
             "admission": self.admission.stats(),
             "affinity": self.affinity.stats(),
         }

@@ -116,6 +116,10 @@ class ReplicaSnapshot:
     tokens_out: int | None = None
     completed: int | None = None
     occupancy: float | None = None
+    # Per-tier SLO burn snapshots from the replica's serving metrics
+    # ({tier: {"ewma": ..., "window_rate": ..., ...}}) — the raw material
+    # for :func:`fleet_slo_rollup`.
+    slo: dict = field(default_factory=dict)
     prefix_digests: frozenset = frozenset()
     prefix_stats: dict = field(default_factory=dict)
     scraped_at: float = 0.0
@@ -159,6 +163,9 @@ def snapshot_replica(
         metrics = serving.get("metrics") or {}
         snap.tokens_per_sec = metrics.get("tokens_per_sec")
         snap.tokens_out = metrics.get("tokens_out")
+        slo = metrics.get("slo")
+        if isinstance(slo, dict):
+            snap.slo = slo
         pool = serving.get("page_pool") or {}
         snap.occupancy = pool.get("mem_occupancy") or pool.get("occupancy")
         snap.active_rows = pool.get("active_rows")
@@ -172,6 +179,42 @@ def snapshot_replica(
             prefix.get("resident_digests") or ()
         )
     return snap
+
+
+def fleet_slo_rollup(
+    snapshots: dict[int, ReplicaSnapshot],
+) -> dict[str, dict]:
+    """Fold per-replica SLO burn snapshots into one fleet-wide view per
+    tier. Rates are **count-weighted** (a replica that served 10× the
+    requests moves the fleet rate 10× as much — an unweighted mean would
+    let an idle replica's clean 0.0 mask a busy replica's burn); the
+    EWMA column takes the fleet max, because burn alerts care about the
+    worst replica, not the average one."""
+    out: dict[str, dict] = {}
+    for snap in snapshots.values():
+        for tier, s in (snap.slo or {}).items():
+            if not isinstance(s, dict):
+                continue
+            agg = out.setdefault(tier, {
+                "window_count": 0, "window_missed": 0,
+                "total": 0, "missed": 0, "max_ewma": 0.0,
+                "replicas": 0,
+            })
+            agg["window_count"] += int(s.get("window_count") or 0)
+            agg["window_missed"] += int(s.get("window_missed") or 0)
+            agg["total"] += int(s.get("total") or 0)
+            agg["missed"] += int(s.get("missed") or 0)
+            agg["max_ewma"] = max(
+                agg["max_ewma"], float(s.get("ewma") or 0.0)
+            )
+            agg["replicas"] += 1
+    for agg in out.values():
+        n = agg["window_count"]
+        agg["window_rate"] = (
+            round(agg["window_missed"] / n, 6) if n else 0.0
+        )
+        agg["max_ewma"] = round(agg["max_ewma"], 6)
+    return dict(sorted(out.items()))
 
 
 class ScrapeLoop:
@@ -264,6 +307,7 @@ class ScrapeLoop:
                 snap.tokens_out = prev.tokens_out
                 snap.completed = prev.completed
                 snap.occupancy = prev.occupancy
+                snap.slo = prev.slo
                 snap.prefix_digests = prev.prefix_digests
                 snap.prefix_stats = prev.prefix_stats
             fresh[rank] = snap
@@ -311,5 +355,9 @@ class ScrapeLoop:
                 "occupancy": s.occupancy,
                 "prefix_entries": s.prefix_stats.get("entries"),
                 "prefix_hit_rate": s.prefix_stats.get("hit_rate"),
+                "slo": {
+                    tier: (v or {}).get("ewma")
+                    for tier, v in sorted((s.slo or {}).items())
+                },
             })
         return out

@@ -28,6 +28,9 @@ import jax
 import numpy as np
 
 from machine_learning_apache_spark_tpu import telemetry
+from machine_learning_apache_spark_tpu.telemetry import (
+    tracectx as _tracectx,
+)
 from machine_learning_apache_spark_tpu.data.text import EOS_ID, SOS_ID
 from machine_learning_apache_spark_tpu.utils import env as envcfg
 from machine_learning_apache_spark_tpu.serving.batcher import (
@@ -216,6 +219,7 @@ class ServingEngine:
         self.queue = RequestQueue(
             max_queue_depth, default_deadline_s=default_deadline_s,
             clock=clock, on_expire=self.metrics.on_expire,
+            on_slo=self.metrics.on_slo,
         )
         self.batcher = Batcher(
             self.queue,
@@ -477,11 +481,23 @@ class ServingEngine:
     def _pad_id(self) -> int:
         return self.translator.model.cfg.pad_id
 
-    def submit(self, text: str, *, deadline_s: float | None = None) -> ServeRequest:
+    def submit(
+        self,
+        text: str,
+        *,
+        deadline_s: float | None = None,
+        tier: str | None = None,
+    ) -> ServeRequest:
         """Tokenize and admit one request; returns its ``ServeRequest``
         (``.result(timeout)`` blocks for the translation). Raises
         ``Backpressure`` at capacity and ``ValueError`` for inputs no
-        bucket can hold — both *before* the request costs decode work."""
+        bucket can hold — both *before* the request costs decode work.
+
+        Distributed tracing: a context already active on the calling
+        thread (a replica handling a routed request) is adopted; a bare
+        local submit mints its own, so standalone engines trace too.
+        ``tier`` tags the request's SLO class for the burn-rate gauges.
+        """
         if self._worker is None:
             raise RuntimeError("engine not started (use start() or `with`) ")
         ids = self.translator.src_pipe.ragged([text])[0]
@@ -495,9 +511,12 @@ class ServingEngine:
         # (metrics.check_conservation) needs every admission attempt in
         # ``submitted`` so rejected ones balance against ``rejected``.
         self.metrics.on_submit()
-        with telemetry.span("serving.submit"):
+        ctx = _tracectx.current() or _tracectx.mint()
+        with _tracectx.use(ctx), telemetry.span("serving.submit"):
             try:
-                req = self.queue.submit(text, ids, deadline_s=deadline_s)
+                req = self.queue.submit(
+                    text, ids, deadline_s=deadline_s, tier=tier
+                )
             except Exception:
                 self.metrics.on_reject()
                 raise
@@ -656,6 +675,9 @@ class ServingEngine:
                 total=now - req.submit_time,
             )
             self.metrics.on_trace(req)
+            self.metrics.on_slo(
+                req.tier, req.deadline is not None and now > req.deadline
+            )
         # Token ledger parity with the padded path (len(content)+1 per
         # request): real emits count EOS when emitted; a budget-exhausted
         # row gets its implicit stop token here.
@@ -788,6 +810,7 @@ class ServingEngine:
             for r in members:
                 if r not in live:
                     self.metrics.on_expire()
+                    self.metrics.on_slo(r.tier, True)
                     r.trace.mark("expire", now, where="slot_wait")
                     r.future.set_exception(
                         DeadlineExceeded(
@@ -878,6 +901,9 @@ class ServingEngine:
                 total=done - r.submit_time,
             )
             self.metrics.on_trace(r)
+            self.metrics.on_slo(
+                r.tier, r.deadline is not None and done > r.deadline
+            )
         # Padding-waste ledger: the rectangle this batch computed (every
         # row, filler included, at full boundary/budget width) versus the
         # tokens that were real.

@@ -24,6 +24,7 @@ every other metric line in the repo.
 
 from __future__ import annotations
 
+import collections
 import math
 import threading
 import time
@@ -34,6 +35,9 @@ from machine_learning_apache_spark_tpu.telemetry import (
 from machine_learning_apache_spark_tpu.telemetry import (
     registry as telemetry_registry,
 )
+from machine_learning_apache_spark_tpu.telemetry import (
+    tracectx as telemetry_trace,
+)
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -42,6 +46,12 @@ log = get_logger(__name__)
 #: /statusz. Small on purpose: exemplars are a debugging entry point
 #: ("which request was slow and where did its time go"), not a log.
 _MAX_EXEMPLARS = 8
+
+#: SLO burn-rate defaults: a 5-minute sliding window (the classic
+#: fast-burn alert horizon) and an EWMA whose ~20-observation memory
+#: answers "is it getting worse right now".
+BURN_WINDOW_S = 300.0
+BURN_ALPHA = 0.1
 
 
 class ConservationError(AssertionError):
@@ -100,6 +110,88 @@ class Histogram:
         }
 
 
+class BurnRate:
+    """Per-tier SLO burn gauge: what fraction of recently retired
+    requests missed their deadline.
+
+    Two views over the same observation stream, because one answers
+    "how bad" and the other "which way is it going":
+
+    - **window_rate** — miss fraction over a sliding ``window_s``-second
+      window (deque of ``(ts, missed)``, pruned on write and read);
+    - **ewma** — per-observation exponential average (``alpha``), the
+      fast-burn trend an alert differentiates on.
+
+    Thread-safe; observed from caller threads (rejects/expiry) and the
+    decode worker (completions) concurrently. One instance per tier,
+    shared shape between the serving ledger and the router ledger so the
+    fleet scrape can roll replicas up without translation.
+    """
+
+    def __init__(
+        self,
+        *,
+        window_s: float = BURN_WINDOW_S,
+        alpha: float = BURN_ALPHA,
+        clock=time.monotonic,
+    ):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        if not 0 < alpha <= 1:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.window_s = window_s
+        self.alpha = alpha
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._events: collections.deque[tuple[float, bool]] = (
+            collections.deque()
+        )
+        self._ewma: float | None = None
+        self._total = 0
+        self._missed = 0
+
+    def observe(self, missed: bool) -> None:
+        now = self.clock()
+        with self._lock:
+            self._events.append((now, bool(missed)))
+            self._prune_locked(now)
+            self._total += 1
+            self._missed += int(bool(missed))
+            x = 1.0 if missed else 0.0
+            self._ewma = (
+                x if self._ewma is None
+                else (1 - self.alpha) * self._ewma + self.alpha * x
+            )
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    def snapshot(self) -> dict:
+        """One JSON-able reading: lifetime totals, windowed miss rate,
+        and the EWMA trend (both None before any observation)."""
+        now = self.clock()
+        with self._lock:
+            self._prune_locked(now)
+            n = len(self._events)
+            misses = sum(1 for _, m in self._events if m)
+            return {
+                "window_s": self.window_s,
+                "window_count": n,
+                "window_missed": misses,
+                "window_rate": round(misses / n, 4) if n else None,
+                "ewma": None if self._ewma is None else round(self._ewma, 4),
+                "total": self._total,
+                "missed": self._missed,
+            }
+
+    @property
+    def ewma(self) -> float:
+        with self._lock:
+            return 0.0 if self._ewma is None else self._ewma
+
+
 class ServingMetrics:
     """One instance per engine; every field is safe to bump from the
     submit path (caller threads) and the worker thread concurrently."""
@@ -143,6 +235,12 @@ class ServingMetrics:
         # slowest-request trace exemplars: list of (total_s, trace dict),
         # kept sorted slowest-first, capped at _MAX_EXEMPLARS.
         self._exemplars: list[tuple[float, dict]] = []
+        # Per-tier SLO burn gauges, created on a tier's first observed
+        # retirement. Each tier's EWMA is mirrored into the registry as
+        # ``mlspark_serving_slo_burn_<tier>`` so /metrics exposes the
+        # fast-burn signal with no extra registration step.
+        self._burn: dict[str, BurnRate] = {}
+        self._burn_gauges: dict[str, object] = {}
         # Mirror the admission counters into the process-global telemetry
         # registry (no-op singletons when MLSPARK_TELEMETRY=0). The registry
         # is cumulative across engines in one process — the Prometheus view;
@@ -241,12 +339,39 @@ class ServingMetrics:
         self.ttft.record(ttft)
         self.total_latency.record(total)
 
+    def on_slo(self, tier: str | None, missed: bool) -> None:
+        """Fold one retired request into its tier's deadline-miss burn
+        gauge. ``tier=None`` (untiered direct submission) counts under
+        ``interactive`` — the standalone engine's implicit class."""
+        tier = tier or "interactive"
+        with self._lock:
+            burn = self._burn.get(tier)
+            if burn is None:
+                burn = self._burn[tier] = BurnRate(clock=self.clock)
+                self._burn_gauges[tier] = (
+                    telemetry_registry.get_registry().gauge(
+                        "serving", f"slo_burn_{tier}"
+                    )
+                )
+            gauge = self._burn_gauges[tier]
+        burn.observe(missed)
+        gauge.set(burn.ewma)
+
+    def slo(self) -> dict:
+        """Per-tier burn-gauge snapshots ({} before any observation) —
+        the ``slo`` section /statusz and the fleet scrape read."""
+        with self._lock:
+            burns = dict(self._burn)
+        return {tier: b.snapshot() for tier, b in sorted(burns.items())}
+
     def on_trace(self, req) -> None:
         """Fold one retired request's trace into the ledger: keep it if it
         is among the slowest seen (the /statusz exemplars), and mirror its
         latency breakdown into the event stream as a ``serving.request``
         annotation so gang-level reports can aggregate request latency
-        across ranks from merged rank files."""
+        across ranks from merged rank files. Emitted under the request's
+        distributed trace context (when it has one) so the annotation
+        stitches into the cross-process trace."""
         trace = getattr(req, "trace", None)
         if trace is None:
             return
@@ -259,9 +384,10 @@ class ServingMetrics:
             self._exemplars.sort(key=lambda e: e[0], reverse=True)
             del self._exemplars[_MAX_EXEMPLARS:]
         if telemetry_events.enabled():
-            telemetry_events.get_log().emit(
-                "annotation", "serving.request", value=total, attrs=bd
-            )
+            with telemetry_trace.use(getattr(trace, "ctx", None)):
+                telemetry_events.get_log().emit(
+                    "annotation", "serving.request", value=total, attrs=bd
+                )
 
     def request_exemplars(self) -> list[dict]:
         """The slowest retired requests' trace dicts, slowest first."""
@@ -362,6 +488,7 @@ class ServingMetrics:
             "batch_occupancy": self.batch_occupancy.summary(),
             "slot_occupancy": self.slot_occupancy.summary(),
             "queue_depth": self.queue_depth.summary(),
+            "slo": self.slo(),
         }
 
     def log_summary(self) -> dict:

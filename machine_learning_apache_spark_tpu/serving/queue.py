@@ -28,6 +28,9 @@ from concurrent.futures import Future
 from typing import Sequence
 
 from machine_learning_apache_spark_tpu.telemetry import events as telemetry_events
+from machine_learning_apache_spark_tpu.telemetry import (
+    tracectx as telemetry_trace,
+)
 
 _REQUEST_IDS = itertools.count()
 _TRACE_IDS = itertools.count()
@@ -50,12 +53,24 @@ class RequestTrace:
     Deliberately lock-free: marks are appended by one thread at a time
     (the request moves queue → worker, never concurrently), and readers
     (``/statusz`` exemplars, flight dumps) copy the append-only list.
+
+    When a distributed trace context (``telemetry.tracectx``) is active
+    on the submitting thread, the trace **adopts** its 128-bit trace id
+    — so the id a replica returns in its 200 payload, the id the batch
+    span links, and the id the router minted are all the same string —
+    and keeps the context (``ctx``) so worker-thread emissions (the
+    ``serving.request`` annotation) can re-activate it.
     """
 
-    __slots__ = ("trace_id", "marks", "launches")
+    __slots__ = ("trace_id", "marks", "launches", "ctx")
 
-    def __init__(self, trace_id: str | None = None):
-        self.trace_id = trace_id or _new_trace_id()
+    def __init__(self, trace_id: str | None = None, *, ctx=None):
+        if ctx is None:
+            ctx = telemetry_trace.current()
+        self.ctx = ctx
+        if trace_id is None:
+            trace_id = ctx.trace_id if ctx is not None else _new_trace_id()
+        self.trace_id = trace_id
         self.marks: list[tuple] = []
         self.launches = 0
 
@@ -161,6 +176,9 @@ class ServeRequest:
     # cache row (queue-wait measurement point).
     admit_time: float | None = None
     slot: int | None = None
+    # SLO service class ("interactive" / "batch"); None for untiered
+    # direct submissions. Feeds the per-tier deadline-miss burn gauges.
+    tier: str | None = None
     # The distributed-tracing identity + timeline: assigned at submit,
     # marked at every stage transition, surfaced as /statusz exemplars
     # and in quarantine flight dumps.
@@ -186,6 +204,7 @@ class RequestQueue:
         default_deadline_s: float | None = None,
         clock=time.monotonic,
         on_expire=None,
+        on_slo=None,
     ):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
@@ -195,6 +214,10 @@ class RequestQueue:
         # Observer for in-queue deadline deaths (the engine wires the
         # metrics ledger here so queue-level expiry is not invisible).
         self.on_expire = on_expire
+        # Per-request SLO observer ``fn(tier, missed)`` — an in-queue
+        # expiry is a deadline miss by definition, so the burn-rate
+        # gauges must see it even though the engine never did.
+        self.on_slo = on_slo
         self.cond = threading.Condition()
         self._pending: list[ServeRequest] = []
         # EWMA of per-request service time (seconds), fed by the engine;
@@ -210,6 +233,7 @@ class RequestQueue:
         ids: Sequence[int],
         *,
         deadline_s: float | None = None,
+        tier: str | None = None,
     ) -> ServeRequest:
         """Admit a request or raise ``Backpressure``. Expired entries are
         purged first so a burst of dead requests can't hold the door shut
@@ -235,6 +259,7 @@ class RequestQueue:
                 ids=list(ids),
                 submit_time=now,
                 deadline=None if deadline_s is None else now + deadline_s,
+                tier=tier,
             )
             req.trace.mark("submit", now, depth=len(self._pending))
             self._pending.append(req)
@@ -281,6 +306,9 @@ class RequestQueue:
                 )
             if self.on_expire is not None:
                 self.on_expire(len(dead))
+            if self.on_slo is not None:
+                for r in dead:
+                    self.on_slo(r.tier, True)
             telemetry_events.annotate(
                 "serving.queue.expire", count=len(dead)
             )

@@ -24,6 +24,9 @@ configuration (the launcher's runner does exactly that).
 See docs/OBSERVABILITY.md for the event schema and workflows.
 """
 
+from machine_learning_apache_spark_tpu.telemetry import (
+    aggregate as _aggregate_mod,
+)
 from machine_learning_apache_spark_tpu.telemetry.aggregate import (
     merge_gang_dir,
     render_markdown,
@@ -75,30 +78,46 @@ from machine_learning_apache_spark_tpu.telemetry.spans import (
     timed_span,
     traced,
 )
+from machine_learning_apache_spark_tpu.telemetry import (
+    tracectx as _tracectx_mod,
+)
+from machine_learning_apache_spark_tpu.telemetry.tracectx import (
+    ENV_TRACE,
+    ENV_TRACE_SAMPLE,
+    TraceContext,
+    current_trace_context,
+    trace_enabled,
+)
 
 
 def reset() -> None:
     """Drop ALL process-global telemetry state (event log, registry,
-    cached enabled flag, beacon, HTTP server + providers) — test hook
-    and fork/spawn re-arm."""
+    cached enabled flag, beacon, HTTP server + providers, trace-context
+    caches) — test hook and fork/spawn re-arm."""
     _http_mod.reset()
+    _tracectx_mod.reset()
     _events_mod.reset()
     _registry_mod.reset()
+    _aggregate_mod.clear_parse_cache()
 
 __all__ = [
     "ENV_TELEMETRY",
     "ENV_TELEMETRY_DIR",
     "ENV_TELEMETRY_HTTP",
+    "ENV_TRACE",
+    "ENV_TRACE_SAMPLE",
     "Event",
     "EventLog",
     "FLIGHT_CAPACITY",
     "MetricsRegistry",
     "TelemetryHTTPServer",
     "Timer",
+    "TraceContext",
     "annotate",
     "beacon",
     "beacon_update",
     "current_span_id",
+    "current_trace_context",
     "dump_flight",
     "enabled",
     "flight_path",
@@ -118,6 +137,7 @@ __all__ = [
     "stop_http_server",
     "telemetry_dir",
     "timed_span",
+    "trace_enabled",
     "traced",
     "unregister_provider",
     "write_rank_file",

@@ -48,9 +48,39 @@ def write_rank_file(directory: str, rank: int | None = None) -> str:
     return path
 
 
+# Parse cache for rank exports, keyed on (mtime_ns, size). Live status
+# tooling (gang_status --watch, the bench's periodic merges) re-merges
+# the same directory on an interval, and most rank files are unchanged
+# between ticks — exports are written once by atomic os.replace, so an
+# (mtime_ns, size) match means byte-identical content. Entries hold the
+# parsed event dicts; every consumer that mutates an event copies it
+# first (merge_rank_files stamps rank onto a dict() copy), so sharing
+# the parsed lists is safe.
+_PARSE_CACHE: dict[str, tuple[tuple[int, int], list[dict]]] = {}
+_PARSE_CACHE_MAX = 64
+
+
+def clear_parse_cache() -> None:
+    """Drop the JSONL parse cache (test hook)."""
+    _PARSE_CACHE.clear()
+
+
 def load_jsonl(path: str) -> list[dict]:
-    """Read one rank's JSONL export. Tolerates a trailing partial line
-    (a killed writer) but raises on malformed interior lines."""
+    """Read one rank's JSONL export (cached by mtime+size — see
+    ``_PARSE_CACHE``). Tolerates a trailing partial line (a killed
+    writer) but raises on malformed interior lines."""
+    path = os.path.abspath(path)
+    try:
+        st = os.stat(path)
+        stamp = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        stamp = None
+    if stamp is not None:
+        hit = _PARSE_CACHE.get(path)
+        if hit is not None and hit[0] == stamp:
+            # Fresh outer list per hit — a caller appending to its result
+            # must not grow the cached copy.
+            return list(hit[1])
     out: list[dict] = []
     with open(path) as f:
         lines = f.read().splitlines()
@@ -63,6 +93,14 @@ def load_jsonl(path: str) -> list[dict]:
             if i == len(lines) - 1:
                 break  # torn final line from a killed process
             raise
+    if stamp is not None:
+        if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+            # Bounded: evict the oldest insertion (a watch loop touches
+            # the same few files; anything beyond the bound is churn).
+            _PARSE_CACHE.pop(next(iter(_PARSE_CACHE)))
+        # The cache keeps its own outer list: the miss path hands the
+        # caller the same isolation a hit does.
+        _PARSE_CACHE[path] = (stamp, list(out))
     return out
 
 

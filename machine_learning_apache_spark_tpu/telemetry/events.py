@@ -64,7 +64,9 @@ def _env_rank() -> int | None:
 class Event:
     """One telemetry record. ``span``/``parent`` are span ids for the
     span_start/span_end pair; ``value`` carries counter increments, gauge
-    levels, and span durations (seconds, on span_end)."""
+    levels, and span durations (seconds, on span_end); ``trace`` is the
+    distributed trace id stamped when a ``telemetry.tracectx`` context
+    was active on the emitting thread."""
 
     kind: str
     name: str
@@ -76,6 +78,7 @@ class Event:
     parent: int | None = None
     value: float | None = None
     attrs: dict | None = None
+    trace: str | None = None
 
     def to_dict(self) -> dict:
         d = {
@@ -94,7 +97,27 @@ class Event:
             d["value"] = self.value
         if self.attrs:
             d["attrs"] = self.attrs
+        if self.trace is not None:
+            d["trace"] = self.trace
         return d
+
+
+# -- distributed-trace thread slot --------------------------------------------
+# The active TraceContext lives HERE (not in tracectx) so ``emit`` can
+# stamp events with one thread-local read and tracectx can import events
+# without a cycle. ``telemetry.tracectx.use`` is the only writer.
+_TRACE_TLS = threading.local()
+
+
+def current_trace():
+    """The TraceContext active on this thread, or None."""
+    return getattr(_TRACE_TLS, "ctx", None)
+
+
+def set_current_trace(ctx) -> None:
+    """Install (or, with None, clear) this thread's active trace context
+    — called by ``telemetry.tracectx.use``, not by instrumentation."""
+    _TRACE_TLS.ctx = ctx
 
 
 class EventLog:
@@ -122,6 +145,7 @@ class EventLog:
     ) -> Event:
         if kind not in KINDS:
             raise ValueError(f"unknown event kind {kind!r} (expected {KINDS})")
+        ctx = getattr(_TRACE_TLS, "ctx", None)
         ev = Event(
             kind=kind,
             name=name,
@@ -133,6 +157,7 @@ class EventLog:
             parent=parent,
             value=value,
             attrs=attrs,
+            trace=None if ctx is None else ctx.trace_id,
         )
         with self._lock:
             if len(self._events) == self.max_events:
@@ -310,9 +335,11 @@ __all__ = [
     "annotate",
     "beacon",
     "beacon_update",
+    "current_trace",
     "enabled",
     "get_log",
     "reset",
+    "set_current_trace",
     "set_enabled",
     "telemetry_dir",
 ]

@@ -209,6 +209,25 @@ def flightz(n: int = FLIGHTZ_TAIL) -> dict:
     }
 
 
+def tracez(trace_id: str | None = None) -> dict:
+    """``/tracez`` payload: distributed-trace view over this process's
+    live event ring — trace summaries, or one stitched tree with
+    ``?id=<trace id>``. Single-process by nature (the ring is local);
+    the cross-process merge is ``tools/trace_report.py``'s job."""
+    # Lazy import: traceview pulls aggregate; the HTTP plane must stay
+    # importable (and cheap) for processes that never serve a trace.
+    from machine_learning_apache_spark_tpu.telemetry import (
+        traceview as _traceview,
+    )
+
+    log = _events.get_log()
+    events = [ev.to_dict() for ev in log.snapshot()]
+    payload = _traceview.tracez_payload(events, trace_id)
+    payload["rank"] = _events._env_rank()
+    payload["pid"] = os.getpid()
+    return payload
+
+
 def _build_info() -> dict:
     info = {"python": sys.version.split()[0]}
     # sys.modules peek, never an import: /statusz must not be the thing
@@ -243,6 +262,11 @@ class _Handler(BaseHTTPRequestHandler):
                 if m:
                     n = max(1, int(m.group(1)))
                 self._reply_json(200, flightz(n))
+            elif path == "/tracez":
+                m = re.search(r"(?:^|&)id=([0-9a-fA-F]+)", query)
+                self._reply_json(
+                    200, tracez(m.group(1).lower() if m else None)
+                )
             else:
                 self._reply_json(404, {"error": f"no endpoint {path!r}"})
         except Exception:  # noqa: BLE001 — a scrape must never kill the thread
@@ -450,6 +474,7 @@ __all__ = [
     "start_http_server",
     "statusz",
     "stop_http_server",
+    "tracez",
     "unregister_provider",
     "write_port_sidecar",
 ]

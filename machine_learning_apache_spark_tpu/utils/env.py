@@ -283,6 +283,18 @@ register(
     description="Flight-recorder event-ring capacity (events kept for "
     "/flightz and crash dumps).",
 )
+register(
+    "MLSPARK_TRACE", type="bool", default=True, subsystem="telemetry",
+    description="Distributed tracing switch: mint/propagate trace "
+    "contexts across router -> replica -> engine hops (no-op whenever "
+    "MLSPARK_TELEMETRY=0).",
+)
+register(
+    "MLSPARK_TRACE_SAMPLE", type="float", default=1.0, subsystem="telemetry",
+    description="Head-based trace sampling probability in [0, 1]; the "
+    "decision is made once per request at the router/engine entry point "
+    "and inherited by every hop.",
+)
 
 # ingest
 register(

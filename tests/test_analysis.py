@@ -327,6 +327,72 @@ class TestJitPass:
         }
 
 
+# -- trace --------------------------------------------------------------------
+class TestTracePass:
+    def test_unwrapped_annotate_and_emit_are_flagged(
+        self, tmp_path, monkeypatch
+    ):
+        findings = lint(tmp_path, monkeypatch, """
+            from telemetry import events as _events
+
+            def terminal(outcome, log):
+                _events.annotate("fleet.request", outcome=outcome)
+                log.emit("annotation", "serving.request", attrs={})
+        """, ["trace"])
+        assert {(f.rule, f.line) for f in findings} == {
+            ("trace-no-context", 5), ("trace-no-context", 6),
+        }
+        assert all(f.severity == "error" for f in findings)
+
+    def test_with_use_block_is_clean_but_nested_def_escapes(
+        self, tmp_path, monkeypatch
+    ):
+        findings = lint(tmp_path, monkeypatch, """
+            from telemetry import events as _events
+            from telemetry import tracectx
+
+            def ok(ctx, log):
+                with tracectx.use(ctx):
+                    _events.annotate("fleet.request", outcome="completed")
+                    log.emit("annotation", "serving.request", attrs={})
+
+            def escape(ctx):
+                with tracectx.use(ctx):
+                    def later():
+                        # runs on another thread, after the with exits
+                        _events.annotate("fleet.request", outcome="x")
+                    return later
+        """, ["trace"])
+        # only the nested-function emission escapes the lexical context
+        assert [(f.rule, f.line) for f in findings] == [
+            ("trace-no-context", 14),
+        ]
+
+    def test_other_annotations_are_not_traced(self, tmp_path, monkeypatch):
+        findings = lint(tmp_path, monkeypatch, """
+            from telemetry import events as _events
+
+            def breadcrumb(log):
+                _events.annotate("serving.queue.reject", depth=3)
+                log.emit("annotation", "gang.teardown", attrs={})
+                log.emit("counter", "fleet.request")
+        """, ["trace"])
+        assert findings == []
+
+    def test_pragma_suppresses_with_justification(
+        self, tmp_path, monkeypatch
+    ):
+        findings = lint(tmp_path, monkeypatch, """
+            from telemetry import events as _events
+
+            def worker(trace):
+                _events.annotate("serving.request", t=1)  # mlspark-lint: ok trace-no-context -- ctx re-activated dynamically
+        """, ["trace"])
+        assert len(findings) == 1
+        assert findings[0].suppressed
+        assert errors(findings) == []
+
+
 # -- config + severity overrides ----------------------------------------------
 class TestConfig:
     def test_read_tool_section_subset(self, tmp_path):

@@ -331,7 +331,7 @@ class _FakeEngine:
         )})()
         self.translator = type("Tr", (), {"trg_pipe": pipe})()
 
-    def submit(self, text, deadline_s=None):
+    def submit(self, text, deadline_s=None, tier=None):
         if self.mode == "backpressure":
             raise Backpressure(7, 0.25)
         self.submitted.append(text)
@@ -548,6 +548,201 @@ class TestRouterDispatch:
         assert router.submit("zzz")["rank"] == 0  # cold prompt: coldest
 
 
+# -- distributed tracing across the fleet hops --------------------------------
+@pytest.fixture()
+def fresh_trace(monkeypatch):
+    """Clean telemetry + tracing state (and no env overrides) for tests
+    that assert on the global event log."""
+    from machine_learning_apache_spark_tpu import telemetry
+
+    for var in ("MLSPARK_TELEMETRY", "MLSPARK_TELEMETRY_DIR",
+                "MLSPARK_TELEMETRY_EVENTS", "MLSPARK_TELEMETRY_HTTP",
+                "MLSPARK_TRACE", "MLSPARK_TRACE_SAMPLE",
+                "MLSPARK_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+class TestRouterTracing:
+    """Router-side trace semantics on the scripted (no-socket) fleet."""
+
+    def test_retry_attempts_are_siblings_under_one_trace(
+        self, scripted, fresh_trace
+    ):
+        from machine_learning_apache_spark_tpu.telemetry import events
+
+        snaps = {0: snap(0, in_flight=0), 1: snap(1, in_flight=5)}
+        fleet, router = scripted({0: "refused"}, snapshots=snaps)
+        assert router.submit("x")["rank"] == 1
+
+        evs = events.get_log().snapshot()
+        submit_start = next(
+            e for e in evs
+            if e.kind == "span_start" and e.name == "fleet.submit"
+        )
+        tid = submit_start.trace
+        assert tid and len(tid) == 32  # minted + sampled at default rate
+        starts = [e for e in evs
+                  if e.kind == "span_start" and e.name == "fleet.attempt"]
+        # Two attempts (503-drained, then retried) land as siblings: same
+        # trace, same fleet.submit parent span ...
+        assert [e.attrs["replica"] for e in starts] == [0, 1]
+        assert {e.trace for e in starts} == {tid}
+        assert {e.parent for e in starts} == {submit_start.span}
+        # ... but each carries its own wire (traceparent child) span id,
+        # so replica-side spans attach to the right attempt.
+        ctx_spans = [e.attrs["ctx_span"] for e in starts]
+        assert len(set(ctx_spans)) == 2
+        ann = next(e for e in evs if e.name == "fleet.request")
+        assert ann.trace == tid
+        assert ann.attrs["retries"] == 1
+        assert ann.attrs["outcome"] == "completed"
+
+    def test_trace_off_serves_untraced(
+        self, scripted, fresh_trace, monkeypatch
+    ):
+        from machine_learning_apache_spark_tpu import telemetry
+        from machine_learning_apache_spark_tpu.telemetry import events
+
+        monkeypatch.setenv("MLSPARK_TRACE", "0")
+        telemetry.reset()
+        fleet, router = scripted({}, snapshots={0: snap(0)})
+        assert router.submit("x")["rank"] == 0  # request unharmed
+        evs = events.get_log().snapshot()
+        assert evs and all(e.trace is None for e in evs)
+        attempt = next(e for e in evs if e.kind == "span_start"
+                       and e.name == "fleet.attempt")
+        assert "ctx_span" not in (attempt.attrs or {})
+
+    def test_router_slo_burn_per_tier(self, scripted, fresh_trace):
+        snaps = {0: snap(0, healthy=False)}
+        fleet, router = scripted({}, snapshots=snaps)
+        with pytest.raises(FleetUnavailable):
+            router.submit("x")  # burns interactive budget
+        slo = router.stats()["slo"]
+        assert slo["interactive"]["total"] == 1
+        assert slo["interactive"]["missed"] == 1
+        assert slo["interactive"]["window_rate"] == 1.0
+        # Recovery: completed-within-deadline requests decay the gauge.
+        router._on_scrape({0: snap(0)})
+        snaps[0] = snap(0)
+        for _ in range(3):
+            router.submit("y")
+        slo = router.stats()["slo"]
+        assert slo["interactive"]["total"] == 4
+        assert slo["interactive"]["missed"] == 1
+        from machine_learning_apache_spark_tpu.telemetry import registry
+
+        snap_reg = registry.get_registry().snapshot()
+        assert "slo_burn_interactive" in snap_reg["fleet"]
+
+
+@pytest.fixture(scope="module")
+def mt_bundle():
+    """Untrained tiny MT bundle (the test_serving idiom): serving
+    semantics need no trained weights, and init is ~instant."""
+    import jax
+    import numpy as np
+
+    from machine_learning_apache_spark_tpu.data.datasets import (
+        synthetic_translation_pairs,
+    )
+    from machine_learning_apache_spark_tpu.data.text import TextPipeline
+    from machine_learning_apache_spark_tpu.inference import Translator
+    from machine_learning_apache_spark_tpu.models import (
+        Transformer,
+        TransformerConfig,
+    )
+
+    pairs = synthetic_translation_pairs(32, min_len=3, max_len=8, seed=0)
+    src_pipe = TextPipeline.fit([s for s, _ in pairs], max_seq_len=14)
+    trg_pipe = TextPipeline.fit([t for _, t in pairs], max_seq_len=14)
+    cfg = TransformerConfig(
+        src_vocab_size=len(src_pipe.vocab.itos),
+        trg_vocab_size=len(trg_pipe.vocab.itos),
+        d_model=32, ffn_hidden=64, num_heads=2, num_layers=1,
+        max_len=16, dropout=0.0,
+    )
+    model = Transformer(cfg)
+    dummy = np.ones((2, 8), np.int32)
+    params = model.init(jax.random.key(0), dummy, dummy)["params"]
+    return Translator(model, params, src_pipe, trg_pipe), [
+        s for s, _ in pairs
+    ]
+
+
+class TestFleetTraceE2E:
+    """One trace id from router mint through the replica HTTP hop into
+    the real engine — the distributed-tracing acceptance path, with one
+    replica per KV discipline so both modes ride the same fleet."""
+
+    def test_one_trace_id_across_both_kv_modes(
+        self, mt_bundle, fresh_trace, tmp_path
+    ):
+        from machine_learning_apache_spark_tpu.telemetry import (
+            events,
+            traceview,
+        )
+
+        t, texts = mt_bundle
+        engines, servers = [], []
+        try:
+            for rank, kv_mode in enumerate(("paged", "padded")):
+                eng = t.serve(
+                    boundaries=(8, 16), max_batch=2, max_wait_s=0.01,
+                    max_new_tokens=8, kv_mode=kv_mode,
+                )
+                engines.append(eng)
+                srv = ReplicaServer(eng, rank=rank, port=0)
+                srv.start(directory=str(tmp_path))
+                servers.append(srv)
+            snaps = {s.rank: snap(s.rank, port=s.port) for s in servers}
+            router = FleetRouter(
+                snapshot_source=lambda: dict(snaps), policy="round_robin",
+            )
+            payloads = [router.submit(texts[i]) for i in range(2)]
+        finally:
+            for srv in servers:
+                srv.stop()
+            for eng in engines:
+                eng.stop()
+
+        assert {p["rank"] for p in payloads} == {0, 1}  # both kv modes
+        evs = events.get_log().snapshot()
+        hexdigits = set("0123456789abcdef")
+        assert len({p["trace_id"] for p in payloads}) == 2
+        for payload in payloads:
+            tid = payload["trace_id"]
+            # The id the replica returned IS the router-minted trace id.
+            assert len(tid) == 32 and set(tid) <= hexdigits
+            mine = [e for e in evs if e.trace == tid]
+            names = {(e.kind, e.name) for e in mine}
+            for span_name in ("fleet.submit", "fleet.attempt",
+                              "fleet.replica", "serving.submit"):
+                assert ("span_end", span_name) in names, (tid, names)
+            assert ("annotation", "fleet.request") in names
+            assert ("annotation", "serving.request") in names
+            # The cross-process edge: the attempt's wire span id is what
+            # the replica recorded as its remote parent.
+            attempt = next(e for e in mine if e.kind == "span_start"
+                           and e.name == "fleet.attempt")
+            rep = next(e for e in mine if e.kind == "span_start"
+                       and e.name == "fleet.replica")
+            assert attempt.attrs["ctx_span"] == rep.attrs["remote_parent"]
+
+        # And the read side stitches each request into one complete tree.
+        trees = traceview.assemble([e.to_dict() for e in evs])
+        for payload in payloads:
+            tree = trees[payload["trace_id"]]
+            summary = traceview.trace_summary(tree)
+            assert summary["complete"], summary
+            assert summary["root"] == "fleet.submit"
+        comp = traceview.completeness(trees)
+        assert comp["fraction"] == 1.0
+
+
 # -- aggregate: fleet report + replica skew -----------------------------------
 class TestFleetAggregate:
     def test_fleet_report_rollup(self):
@@ -623,6 +818,47 @@ def test_fleet_bench_smoke_subprocess(tmp_path):
     }
     assert artifact["parity"]["identical"] is True
     assert artifact["conservation"]["router_ledger"]["in_flight"] == 0
+
+
+def test_trace_bench_smoke_subprocess(tmp_path):
+    """tools/trace_bench.py --smoke: the BENCH_SERVE_r06 gates in tier-1
+    form — traced-vs-untraced paged sweeps (same-run overhead floor),
+    engine-level trace completeness over the whole traced sweep, and a
+    2-replica fleet section where every minted trace must stitch into
+    one fleet.submit-rooted tree across the HTTP hop."""
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "trace_bench.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo_root, "tools", "trace_bench.py"),
+            "--smoke", "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=480,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    artifact = json.loads(out.read_text())
+    assert artifact["ok"] is True
+    assert artifact["gates"] == {
+        "overhead": True,
+        "vs_r05": True,
+        "trace_complete_engine": True,
+        "trace_complete_fleet": True,
+        "zero_recompiles": True,
+        "conservation": True,
+        "midload_scrape": True,
+    }
+    # The smoke never compares a tiny model's knee to r05 — the skip
+    # must be recorded, not silent.
+    assert artifact["knee"]["gate_skipped_reason"]
+    assert artifact["trace_complete"]["engine"]["fraction"] >= 0.99
+    fleet = artifact["trace_complete"]["fleet"]
+    assert fleet["both_replicas_served"] is True
+    assert fleet["fraction"] >= 0.99
 
 
 @pytest.mark.slow

@@ -24,6 +24,8 @@ from machine_learning_apache_spark_tpu.telemetry import (
     recorder,
     registry,
     spans,
+    tracectx,
+    traceview,
 )
 
 pytestmark = pytest.mark.telemetry
@@ -40,6 +42,8 @@ def fresh_telemetry(monkeypatch):
     monkeypatch.delenv(events.ENV_TELEMETRY_DIR, raising=False)
     monkeypatch.delenv(events.ENV_MAX_EVENTS, raising=False)
     monkeypatch.delenv(http.ENV_TELEMETRY_HTTP, raising=False)
+    monkeypatch.delenv(tracectx.ENV_TRACE, raising=False)
+    monkeypatch.delenv(tracectx.ENV_TRACE_SAMPLE, raising=False)
     monkeypatch.delenv("MLSPARK_PROCESS_ID", raising=False)
     telemetry.reset()
     yield
@@ -753,3 +757,336 @@ class TestStatusMarkdown:
     def test_missing_fields_render_dashes(self):
         md = aggregate.render_status_markdown([{"rank": 0}])
         assert "| 0 | - | - | - |" in md
+
+
+# -- distributed trace context -------------------------------------------------
+
+
+class TestTraceContext:
+    def test_mint_shape_and_uniqueness(self):
+        hexdigits = set("0123456789abcdef")
+        ctxs = [tracectx.mint() for _ in range(8)]
+        assert all(c is not None and c.sampled for c in ctxs)
+        for c in ctxs:
+            assert len(c.trace_id) == 32 and set(c.trace_id) <= hexdigits
+            assert len(c.span_id) == 16 and set(c.span_id) <= hexdigits
+        assert len({c.trace_id for c in ctxs}) == 8
+
+    def test_use_stamps_events_and_restores(self):
+        ctx = tracectx.mint()
+        assert tracectx.current() is None
+        with tracectx.use(ctx):
+            assert tracectx.current() is ctx
+            telemetry.annotate("traced")
+            # use(None) is a passthrough — the active context survives
+            with tracectx.use(None):
+                assert tracectx.current() is ctx
+                telemetry.annotate("still-traced")
+        assert tracectx.current() is None
+        telemetry.annotate("untraced")
+        traces = [e.trace for e in events.get_log().snapshot()]
+        assert traces == [ctx.trace_id, ctx.trace_id, None]
+
+    def test_nested_use_restores_outer(self):
+        a, b = tracectx.mint(), tracectx.mint()
+        with tracectx.use(a):
+            with tracectx.use(b):
+                assert tracectx.current() is b
+            assert tracectx.current() is a
+
+    def test_child_shares_trace_with_fresh_span(self):
+        ctx = tracectx.mint()
+        kid = tracectx.child(ctx)
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id != ctx.span_id
+        assert kid.flags == ctx.flags
+        assert tracectx.child(None) is None
+
+    def test_mint_none_when_disabled_or_unsampled(self, monkeypatch):
+        monkeypatch.setenv(tracectx.ENV_TRACE, "0")
+        telemetry.reset()
+        assert not tracectx.trace_enabled()
+        assert tracectx.mint() is None
+
+        monkeypatch.delenv(tracectx.ENV_TRACE, raising=False)
+        monkeypatch.setenv(tracectx.ENV_TRACE_SAMPLE, "0.0")
+        telemetry.reset()
+        assert tracectx.trace_enabled()
+        assert tracectx.mint() is None  # head sampler declines
+        assert tracectx.mint(sampled=True) is not None  # explicit override
+
+        # tracing never outlives telemetry itself
+        monkeypatch.delenv(tracectx.ENV_TRACE_SAMPLE, raising=False)
+        telemetry.reset()
+        events.set_enabled(False)
+        assert not tracectx.trace_enabled()
+        assert tracectx.mint() is None
+
+    def test_sample_rate_clamps_and_tolerates_garbage(self, monkeypatch):
+        for raw, expect in [("0.25", 0.25), ("2.5", 1.0), ("-1", 0.0),
+                            ("nope", 1.0), ("", 1.0)]:
+            monkeypatch.setenv(tracectx.ENV_TRACE_SAMPLE, raw)
+            telemetry.reset()
+            assert tracectx.sample_rate() == expect, raw
+
+    def test_traceparent_round_trip(self):
+        ctx = tracectx.mint()
+        header = tracectx.to_traceparent(ctx)
+        assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+        back = tracectx.parse_traceparent(header)
+        assert back == ctx
+        # uppercase and surrounding whitespace are tolerated on the wire
+        assert tracectx.parse_traceparent(f"  {header.upper()}  ") == ctx
+
+    def test_traceparent_garbage_yields_none(self):
+        good_trace, good_span = "ab" * 16, "cd" * 8
+        bad = [
+            None,
+            b"00-" + b"ab" * 16,
+            "",
+            "not-a-header",
+            f"00-{good_trace}-{good_span}",          # missing flags
+            f"00-{good_trace}-{good_span}-01-extra",  # too many parts
+            f"ff-{good_trace}-{good_span}-01",        # forbidden version
+            f"0x-{good_trace}-{good_span}-01",        # non-hex version
+            f"00-{'0' * 32}-{good_span}-01",          # all-zero trace id
+            f"00-{good_trace}-{'0' * 16}-01",         # all-zero span id
+            f"00-{good_trace[:-2]}-{good_span}-01",   # short trace id
+            f"00-{good_trace}-{good_span}-zz",        # non-hex flags
+        ]
+        for header in bad:
+            assert tracectx.parse_traceparent(header) is None, header
+
+
+# -- traceview: stitching, completeness, Perfetto export -----------------------
+
+
+def _fleet_trace_events(tid="ab" * 16, wire="11" * 8, with_attempt=True):
+    """Synthetic router (pid 100, driver) + replica (pid 200, rank 1)
+    exports for one traced request, joined by the ctx_span/remote_parent
+    cross-process edge."""
+    router = [
+        {"kind": "span_start", "name": "fleet.submit", "ts": 0.0,
+         "wall": 100.0, "rank": None, "pid": 100, "span": 1,
+         "parent": None, "trace": tid},
+        {"kind": "span_end", "name": "fleet.submit", "ts": 0.5,
+         "wall": 100.5, "rank": None, "pid": 100, "span": 1,
+         "parent": None, "trace": tid, "value": 0.5},
+        {"kind": "annotation", "name": "fleet.request", "ts": 0.5,
+         "wall": 100.5, "rank": None, "pid": 100, "trace": tid,
+         "attrs": {"outcome": "completed"}},
+    ]
+    if with_attempt:
+        router[1:1] = [
+            {"kind": "span_start", "name": "fleet.attempt", "ts": 0.01,
+             "wall": 100.01, "rank": None, "pid": 100, "span": 2,
+             "parent": 1, "trace": tid,
+             "attrs": {"replica": 1, "ctx_span": wire}},
+            {"kind": "span_end", "name": "fleet.attempt", "ts": 0.4,
+             "wall": 100.4, "rank": None, "pid": 100, "span": 2,
+             "parent": 1, "trace": tid, "value": 0.39},
+        ]
+    replica = [
+        {"kind": "span_start", "name": "fleet.replica", "ts": 5.0,
+         "wall": 100.02, "rank": 1, "pid": 200, "span": 7, "parent": None,
+         "trace": tid, "attrs": {"remote_parent": wire}},
+        {"kind": "span_end", "name": "fleet.replica", "ts": 5.3,
+         "wall": 100.35, "rank": 1, "pid": 200, "span": 7, "parent": None,
+         "trace": tid, "value": 0.33},
+        {"kind": "counter", "name": "queue.depth", "ts": 5.1,
+         "wall": 100.1, "rank": 1, "pid": 200, "value": 3.0},
+    ]
+    return router + replica
+
+
+class TestTraceView:
+    def test_assemble_resolves_remote_edge(self):
+        trees = traceview.assemble(_fleet_trace_events())
+        assert list(trees) == ["ab" * 16]
+        tree = trees["ab" * 16]
+        assert [n["name"] for n in tree["roots"]] == ["fleet.submit"]
+        assert tree["orphans"] == []
+        assert tree["span_count"] == 3
+        attempt = tree["roots"][0]["children"][0]
+        assert attempt["name"] == "fleet.attempt"
+        rep = attempt["children"][0]
+        assert rep["name"] == "fleet.replica"
+        assert rep["via"] == "remote"
+        assert rep["rank"] == 1 and rep["dur_s"] == 0.33
+        assert [a["name"] for a in tree["annotations"]] == ["fleet.request"]
+        summary = traceview.trace_summary(tree)
+        assert summary["complete"] is True
+        assert summary["root"] == "fleet.submit"
+        assert summary["total_s"] == 0.5
+        assert summary["processes"] == 2
+
+    def test_unresolved_remote_parent_is_an_orphan(self):
+        trees = traceview.assemble(
+            _fleet_trace_events(with_attempt=False)
+        )
+        tree = trees["ab" * 16]
+        assert [n["name"] for n in tree["orphans"]] == ["fleet.replica"]
+        summary = traceview.trace_summary(tree)
+        assert summary["complete"] is False
+        comp = traceview.completeness(trees)
+        assert comp == {"traces": 1, "complete": 0, "fraction": 0.0}
+
+    def test_completeness_and_slowest_over_many_traces(self):
+        evs = _fleet_trace_events(tid="aa" * 16, wire="11" * 8)
+        slow = [
+            {"kind": "span_start", "name": "fleet.submit", "ts": 0.0,
+             "wall": 200.0, "rank": None, "pid": 100, "span": 9,
+             "parent": None, "trace": "bb" * 16},
+            {"kind": "span_end", "name": "fleet.submit", "ts": 2.0,
+             "wall": 202.0, "rank": None, "pid": 100, "span": 9,
+             "parent": None, "trace": "bb" * 16, "value": 2.0},
+        ]
+        trees = traceview.assemble(evs + slow)
+        comp = traceview.completeness(trees)
+        assert comp == {"traces": 2, "complete": 2, "fraction": 1.0}
+        rows = traceview.slowest(trees, n=10)
+        assert [r["trace_id"] for r in rows] == ["bb" * 16, "aa" * 16]
+        assert traceview.slowest(trees, n=1)[0]["total_s"] == 2.0
+
+    def test_perfetto_export_shape(self):
+        doc = traceview.perfetto_export(_fleet_trace_events())
+        assert doc["displayTimeUnit"] == "ms"
+        evs = doc["traceEvents"]
+        json.dumps(doc)  # valid Chrome trace JSON end to end
+        by_ph = {}
+        for e in evs:
+            by_ph.setdefault(e["ph"], []).append(e)
+        # 3 slices, one s->f flow over the remote edge, 1 instant,
+        # 1 counter, and name+sort metadata for both processes
+        assert len(by_ph["X"]) == 3
+        assert len(by_ph["s"]) == len(by_ph["f"]) == 1
+        assert len(by_ph["i"]) == 1
+        assert len(by_ph["C"]) == 1
+        assert len(by_ph["M"]) == 4
+        # replica rows key on gang rank, driver rows on OS pid
+        assert {e["pid"] for e in by_ph["X"]} == {100, 1}
+        names = {e["args"]["name"] for e in by_ph["M"]
+                 if e["name"] == "process_name"}
+        assert names == {"driver pid=100", "rank 1"}
+        # flow arrow ties the attempt slice to the replica slice
+        s, f = by_ph["s"][0], by_ph["f"][0]
+        assert s["id"] == f["id"] == "11" * 8
+        assert s["pid"] == 100 and f["pid"] == 1
+        # wall-clock micros; traced spans share a per-trace track id
+        submit = next(e for e in by_ph["X"] if e["name"] == "fleet.submit")
+        assert submit["ts"] == 100.0 * 1e6 and submit["dur"] == 0.5 * 1e6
+        assert submit["tid"] == int("ab" * 4, 16) & 0x3FFFFFFF
+
+    def test_perfetto_trace_filter_and_untraced_track(self):
+        evs = _fleet_trace_events() + [
+            {"kind": "span_start", "name": "train.step", "ts": 9.0,
+             "wall": 300.0, "rank": 0, "pid": 300, "span": 42,
+             "parent": None},
+            {"kind": "span_end", "name": "train.step", "ts": 9.1,
+             "wall": 300.1, "rank": 0, "pid": 300, "span": 42,
+             "parent": None, "value": 0.1},
+        ]
+        full = traceview.perfetto_export(evs)
+        slices = [e for e in full["traceEvents"] if e["ph"] == "X"]
+        train = next(e for e in slices if e["name"] == "train.step")
+        assert train["tid"] == 0  # untraced spans share track 0
+        only = traceview.perfetto_export(evs, trace_id="ab" * 16)
+        names = {e["name"] for e in only["traceEvents"] if e["ph"] == "X"}
+        assert "train.step" not in names
+        assert "fleet.submit" in names
+
+    def test_tracez_payload_summary_and_tree(self):
+        evs = _fleet_trace_events()
+        summary = traceview.tracez_payload(evs)
+        assert summary["artifact"] == "tracez"
+        assert summary["completeness"]["traces"] == 1
+        assert len(summary["traces"]) == 1
+        tree = traceview.tracez_payload(evs, "ab" * 16)
+        assert tree["trace_id"] == "ab" * 16
+        assert [n["name"] for n in tree["roots"]] == ["fleet.submit"]
+        missing = traceview.tracez_payload(evs, "ff" * 16)
+        assert missing["error"] == "unknown trace id"
+
+    def test_live_tracez_endpoint_payload(self):
+        """The /tracez payload over the live ring: the real span layer
+        feeds the real stitcher."""
+        ctx = tracectx.mint()
+        with tracectx.use(ctx), telemetry.span("fleet.submit"):
+            pass
+        payload = http.tracez()
+        assert payload["artifact"] == "tracez"
+        assert payload["completeness"]["complete"] == 1
+        tree = http.tracez(ctx.trace_id)
+        assert [n["name"] for n in tree["roots"]] == ["fleet.submit"]
+
+    def test_load_dir_merges_rank_files_and_flight_dumps(self, tmp_path):
+        d = str(tmp_path)
+        _write_rank_jsonl(d, 0, {"fleet.submit": [0.5]})
+        # A crashed replica's only export is its flight dump; its events
+        # must merge in (rank-stamped) without duplicating rank files.
+        with open(os.path.join(d, "flight_1.json"), "w") as f:
+            json.dump({"rank": 1, "events": [
+                {"kind": "span_start", "name": "fleet.replica", "ts": 0.1,
+                 "wall": 1e9, "rank": None, "pid": 2, "span": 1},
+            ]}, f)
+        evs = traceview.load_dir(d)
+        assert len(evs) == 3
+        replica = next(e for e in evs if e["name"] == "fleet.replica")
+        assert replica["rank"] == 1
+        # dedup: re-listing the same events in a second dump adds nothing
+        with open(os.path.join(d, "flight_2.json"), "w") as f:
+            json.dump({"rank": 1, "events": [dict(replica)]}, f)
+        assert len(traceview.load_dir(d)) == 3
+
+
+# -- aggregate: the mtime/size-keyed JSONL parse cache -------------------------
+
+
+class TestParseCache:
+    def _write(self, path, names):
+        with open(path + ".tmp", "w") as f:
+            for i, name in enumerate(names):
+                f.write(json.dumps({
+                    "kind": "annotation", "name": name, "ts": float(i),
+                    "wall": 1e9 + i, "rank": None, "pid": 1,
+                }) + "\n")
+        os.replace(path + ".tmp", path)
+
+    def test_hit_returns_fresh_outer_list(self, tmp_path):
+        path = str(tmp_path / "telemetry_rank0.jsonl")
+        self._write(path, ["a", "b"])
+        first = aggregate.load_jsonl(path)
+        second = aggregate.load_jsonl(path)
+        assert first == second
+        assert first is not second  # callers own their list
+        first.append({"name": "poison"})
+        assert [e["name"] for e in aggregate.load_jsonl(path)] == ["a", "b"]
+
+    def test_rewrite_invalidates(self, tmp_path):
+        path = str(tmp_path / "telemetry_rank0.jsonl")
+        self._write(path, ["a"])
+        assert len(aggregate.load_jsonl(path)) == 1
+        self._write(path, ["a", "b", "c"])  # atomic replace, new stamp
+        assert len(aggregate.load_jsonl(path)) == 3
+
+    def test_merge_rank_stamping_does_not_poison_cache(self, tmp_path):
+        path = str(tmp_path / aggregate.rank_file_name(3))
+        self._write(path, ["a"])
+        merged = aggregate.merge_rank_files({3: path})
+        assert merged[0]["rank"] == 3  # stamped on a copy
+        assert aggregate.load_jsonl(path)[0]["rank"] is None
+
+    def test_reset_clears_the_cache(self, tmp_path):
+        path = str(tmp_path / "telemetry_rank0.jsonl")
+        self._write(path, ["a"])
+        aggregate.load_jsonl(path)
+        assert aggregate._PARSE_CACHE
+        telemetry.reset()
+        assert not aggregate._PARSE_CACHE
+
+    def test_cache_is_bounded(self, tmp_path):
+        for i in range(aggregate._PARSE_CACHE_MAX + 8):
+            path = str(tmp_path / f"telemetry_rank{i}.jsonl")
+            self._write(path, ["a"])
+            aggregate.load_jsonl(path)
+        assert len(aggregate._PARSE_CACHE) <= aggregate._PARSE_CACHE_MAX

@@ -52,6 +52,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from serve_bench import build_translator  # noqa: E402
 
+from machine_learning_apache_spark_tpu.utils.sysinfo import host_load  # noqa: E402
+
 #: Affinity hit rate must beat round-robin by at least this factor.
 AFFINITY_GATE_RATIO = 1.5
 #: Fleet tokens/sec must reach this multiple of single-replica (when the
@@ -387,6 +389,7 @@ def run_smoke(out_path: str | None) -> int:
     """Tier-1 entry: 2-replica gang + router; parity + conservation."""
     import tempfile
 
+    host = host_load()  # preflight — before any replica spawns
     translator, texts = build_translator(tiny=True)
     knobs = bench_knobs(tiny=True)
     workdir = tempfile.mkdtemp(prefix="mlspark_fleet_smoke_")
@@ -422,6 +425,8 @@ def run_smoke(out_path: str | None) -> int:
     artifact = {
         "bench": "fleet",
         "smoke": True,
+        "host_load": host,
+        "contended": host["contended"],
         "parity": parity,
         "load": load,
         "conservation": conservation,
@@ -441,6 +446,7 @@ def run_full(out_path: str, *, replicas: int, clients: int,
              duration: float) -> int:
     import tempfile
 
+    host = host_load()  # preflight — before any replica spawns
     translator, texts = build_translator(tiny=True)
     knobs = bench_knobs(tiny=True)
     base = tempfile.mkdtemp(prefix="mlspark_fleet_bench_")
@@ -492,6 +498,8 @@ def run_full(out_path: str, *, replicas: int, clients: int,
         "bench": "fleet",
         "round": 4,
         "smoke": False,
+        "host_load": host,
+        "contended": host["contended"],
         "replicas": replicas,
         "clients": clients,
         "duration_s": duration,

@@ -60,6 +60,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from machine_learning_apache_spark_tpu.utils.sysinfo import host_load  # noqa: E402
+
 
 def build_translator(tiny: bool):
     """Lightly-trained tiny translator. Throughput numbers do not care
@@ -445,6 +447,14 @@ def main() -> None:
     # gate loudly) wins.
     os.environ.setdefault("MLSPARK_TELEMETRY_HTTP", "0")
 
+    # Machine-contention preflight: snapshot host load BEFORE the bench
+    # warms anything, so the artifact records the competition it ran
+    # against (a contended stamp is how a reviewer triages a soft knee).
+    host = host_load()
+    if host["contended"]:
+        print(json.dumps({"warning": "host contended at preflight",
+                          "host_load": host}), flush=True)
+
     translator, texts = build_translator(tiny=smoke)
     knobs = dict(
         boundaries=(8, 16), max_batch=8, max_wait_s=0.005,
@@ -538,6 +548,8 @@ def main() -> None:
         "bench": "serve",
         "smoke": smoke,
         "platform": _platform(),
+        "host_load": host,
+        "contended": host["contended"],
         "duration_per_level_s": duration,
         "parity": parity,
         "token_match": token_match,
