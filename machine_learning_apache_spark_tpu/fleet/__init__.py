@@ -18,7 +18,13 @@ the layer that turns those N replicas into one service:
   conservation ledger over every routed request;
 - :mod:`~.replica` — the per-rank data plane: ``POST /v1/generate``
   over one engine plus the delegated observability GET endpoints, and
-  ``serve_replica`` as the launcher-gang worker body.
+  ``serve_replica`` as the launcher-gang worker body;
+- :mod:`~.autoscaler` — the closed loop over all of the above:
+  :class:`~.autoscaler.FleetAutoscaler` watches scrape snapshots and
+  resizes the ``ReplicaGang`` (SLO burn / queue depth up, coldest-
+  replica drain down, exhausted ranks absorbed as observed
+  scale-downs), logging every decision as a ``fleet.autoscaler``
+  annotation.
 
 Replica gangs with *per-rank* restart (vs the Distributor's
 all-or-nothing barrier semantics) live in
@@ -36,6 +42,10 @@ from machine_learning_apache_spark_tpu.fleet.admission import (
 from machine_learning_apache_spark_tpu.fleet.affinity import (
     AffinityTable,
     prefix_digest,
+)
+from machine_learning_apache_spark_tpu.fleet.autoscaler import (
+    AutoscaleConfig,
+    FleetAutoscaler,
 )
 from machine_learning_apache_spark_tpu.fleet.replica import (
     ReplicaServer,
@@ -60,7 +70,9 @@ from machine_learning_apache_spark_tpu.fleet.scrape import (
 
 __all__ = [
     "AffinityTable",
+    "AutoscaleConfig",
     "FleetAdmission",
+    "FleetAutoscaler",
     "FleetBackpressure",
     "FleetRequestFailed",
     "FleetRouter",

@@ -137,6 +137,10 @@ class FleetAdmission:
         self._lock = threading.Lock()
         self._tier_in_flight: dict[str, int] = {t: 0 for t in self.tiers}
         self._tenant_in_flight: dict[str, int] = {}
+        # Temporary per-tier cap multipliers (0 < factor <= 1). The
+        # autoscaler sheds the batch tier during a scale-down drain so
+        # the shrinking fleet's headroom goes to interactive traffic.
+        self._shed: dict[str, float] = {}
         # EWMA of observed fleet service time, seeding retry-after.
         self._service_ewma = 0.05
         self.admitted = 0
@@ -156,11 +160,12 @@ class FleetAdmission:
             )
         with self._lock:
             tier_depth = self._tier_in_flight[tier]
-            if tier_depth >= slo.max_in_flight:
+            cap = self._effective_cap_locked(tier, slo)
+            if tier_depth >= cap:
                 self.rejected_tier += 1
                 raise FleetBackpressure(
                     tier_depth,
-                    self._retry_after_locked(tier_depth, slo.max_in_flight),
+                    self._retry_after_locked(tier_depth, cap),
                     scope=f"tier:{tier}",
                 )
             if tenant is not None and self.tenant_max_in_flight is not None:
@@ -201,6 +206,32 @@ class FleetAdmission:
             if service_s is not None and service_s >= 0:
                 self._service_ewma += 0.2 * (service_s - self._service_ewma)
 
+    # -- shedding (the autoscaler's drain-time lever) ------------------------
+    def shed(self, tier: str, factor: float) -> None:
+        """Temporarily scale ``tier``'s concurrency cap by ``factor``
+        (0 < factor <= 1). At most one shed per tier; re-shedding
+        replaces the factor. The floor is a cap of 1 — shedding never
+        closes a tier entirely."""
+        if tier not in self.tiers:
+            raise ValueError(
+                f"unknown SLO tier {tier!r} (have {sorted(self.tiers)})"
+            )
+        if not 0.0 < factor <= 1.0:
+            raise ValueError(f"shed factor must be in (0, 1], got {factor}")
+        with self._lock:
+            self._shed[tier] = factor
+
+    def unshed(self, tier: str) -> None:
+        """Restore ``tier``'s full concurrency cap; idempotent."""
+        with self._lock:
+            self._shed.pop(tier, None)
+
+    def _effective_cap_locked(self, tier: str, slo: SLOTier) -> int:
+        factor = self._shed.get(tier)
+        if factor is None:
+            return slo.max_in_flight
+        return max(1, int(slo.max_in_flight * factor))
+
     def _retry_after_locked(self, depth: int, cap: int) -> float:
         # One EWMA service-time per slot we'd have to wait for, floored
         # so clients can't spin: same shape as RequestQueue's estimate.
@@ -215,6 +246,10 @@ class FleetAdmission:
                     name: {
                         "in_flight": self._tier_in_flight[name],
                         "max_in_flight": slo.max_in_flight,
+                        "effective_max_in_flight": (
+                            self._effective_cap_locked(name, slo)
+                        ),
+                        "shed_factor": self._shed.get(name),
                         "deadline_s": slo.deadline_s,
                     }
                     for name, slo in self.tiers.items()

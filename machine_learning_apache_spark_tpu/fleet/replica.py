@@ -59,6 +59,15 @@ def fleet_sidecar_name(rank: int) -> str:
     return f"fleet_rank{rank}.json"
 
 
+def drain_marker_name(rank: int) -> str:
+    """Per-rank drain marker: the autoscaler (via ``ReplicaGang.
+    retire_rank``) drops this file in the fleet dir to tell exactly one
+    replica to stop accepting work, finish its in-flight, and exit. The
+    JSON body carries the drain ``deadline`` (epoch seconds) past which
+    the replica exits regardless."""
+    return f"fleet_drain_rank{rank}"
+
+
 def write_fleet_sidecar(
     port: int, directory: str | None = None, rank: int | None = None
 ) -> str | None:
@@ -136,6 +145,16 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 )
             elif self.path.startswith("/healthz"):
                 payload, healthy = _thttp.healthz()
+                owner: ReplicaServer = self.server.replica  # type: ignore[attr-defined]
+                if owner.draining:
+                    # Drain outranks the engine's own verdict: the scrape
+                    # plane must see "draining" (a deliberate, live exit)
+                    # rather than "degraded" (a failure), so membership
+                    # accounting doesn't count the retirement as an
+                    # outage.
+                    payload = dict(payload)
+                    payload["status"] = "draining"
+                    healthy = False
                 self._reply(200 if healthy else 503, payload)
             elif self.path.startswith("/flightz"):
                 self._reply(200, _thttp.flightz())
@@ -206,11 +225,25 @@ class ReplicaServer:
         self._thread: threading.Thread | None = None
         self.sidecar_path: str | None = None
         self._lock = threading.Lock()
+        self._draining = False
         self.requests = 0
         self.completed = 0
         self.rejected = 0
         self.refused_503 = 0
         self.failed = 0
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def set_draining(self, flag: bool = True) -> None:
+        """Flip the front door to refuse-new-work mode: ``/healthz``
+        answers 503 with status "draining" and ``generate`` refuses with
+        503, while already-accepted requests run to completion."""
+        if flag and not self._draining:
+            _events.annotate("fleet.replica_draining", rank=self.rank,
+                             port=self.port)
+        self._draining = bool(flag)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, *, directory: str | None = None) -> "ReplicaServer":
@@ -289,6 +322,13 @@ class ReplicaServer:
     ) -> tuple[int, dict]:
         with self._lock:
             self.requests += 1
+        if self._draining:
+            with self._lock:
+                self.refused_503 += 1
+            return 503, {
+                "error": "replica draining",
+                "rank": self.rank,
+            }
         if not self._healthy():
             # Drain signal: degraded replicas refuse *before* the queue,
             # so a quarantined engine's backlog drains while new traffic
@@ -390,15 +430,42 @@ def serve_replica(
     with engine:
         server = ReplicaServer(engine, rank=rank, port=port)
         server.start(directory=d)
+        drain_marker = os.path.join(d, drain_marker_name(server.rank))
         try:
             _events.beacon_update(phase="serving")
             deadline = time.monotonic() + max_s
             while time.monotonic() < deadline:
                 if os.path.exists(stop_marker):
                     break
+                if not server.draining and os.path.exists(drain_marker):
+                    # Retirement order from the autoscaler: refuse new
+                    # work, let in-flight finish, then exit — or exit at
+                    # the marker's wall-clock deadline, whichever first.
+                    server.set_draining(True)
+                if server.draining:
+                    in_flight = engine.metrics.ledger().get("in_flight") or 0
+                    if in_flight <= 0:
+                        break
+                    if time.time() >= _read_drain_deadline(drain_marker):
+                        break
                 time.sleep(poll_s)
             stats = server.stats()
         finally:
             server.stop()
         ledger = engine.metrics.ledger()
-    return {"server": stats, "ledger": ledger}
+    if server.draining:
+        _events.annotate("fleet.replica_retired", rank=server.rank,
+                         in_flight=ledger.get("in_flight"))
+    return {"server": stats, "ledger": ledger, "drained": server.draining}
+
+
+def _read_drain_deadline(path: str) -> float:
+    """Wall-clock deadline carried by a drain marker; ``inf`` when the
+    marker is empty or torn (the in-flight-zero exit still applies, and
+    the gang's supervisor holds its own kill backstop)."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        return float(payload["deadline"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return float("inf")

@@ -248,6 +248,9 @@ class FleetRouter:
         # rank is skipped until a scrape reports it healthy again (the
         # scrape loop is the source of recovery truth).
         self._down: dict[int, float] = {}
+        # Ranks present in the last scrape — the diff against each fresh
+        # tick identifies vanished ranks whose routing state must purge.
+        self._seen_ranks: set[int] = set()
         self.submitted = 0
         self.completed = 0
         self.rejected = 0      # fleet admission / all-replica backpressure
@@ -292,8 +295,11 @@ class FleetRouter:
 
     # -- scrape feedback -----------------------------------------------------
     def _on_scrape(self, snapshots: dict[int, ReplicaSnapshot]) -> None:
-        """Scrape tick: refresh affinity residency and let recovered
-        replicas out of the penalty box."""
+        """Scrape tick: refresh affinity residency, let recovered
+        replicas out of the penalty box, and purge *all* routing state
+        for ranks that vanished from discovery — a retired rank's stale
+        penalty-box or affinity entry must not shadow a future rank
+        reusing the slot."""
         with self._lock:
             for rank, snap in snapshots.items():
                 if snap.healthy:
@@ -301,6 +307,12 @@ class FleetRouter:
             gone = [r for r in self._down if r not in snapshots]
             for r in gone:
                 self._down.pop(r, None)
+            vanished = [
+                r for r in self._seen_ranks if r not in snapshots
+            ]
+            self._seen_ranks = set(snapshots)
+        for r in vanished:
+            self.affinity.forget_rank(r)
         for rank, snap in snapshots.items():
             if snap.healthy:
                 self.affinity.observe_scrape(rank, snap.prefix_digests)

@@ -108,7 +108,7 @@ class ReplicaSnapshot:
     rank: int
     port: int
     healthy: bool = False
-    status: str = "unreachable"  # ok | degraded | unreachable
+    status: str = "unreachable"  # ok | degraded | draining | unreachable
     queue_depth: int | None = None
     in_flight: int | None = None
     active_rows: int | None = None
@@ -124,6 +124,16 @@ class ReplicaSnapshot:
     prefix_stats: dict = field(default_factory=dict)
     scraped_at: float = 0.0
     consecutive_failures: int = 0
+
+    @property
+    def draining(self) -> bool:
+        """Deliberately refusing new work while it retires its in-flight
+        (the autoscaler's scale-down protocol). Unhealthy for dispatch —
+        the router must not send it anything — but *not* a failure
+        signal: a draining replica answered its scrape, so it never
+        burns the unreachable grace, and membership accounting counts it
+        as a live, leaving rank rather than a dead one."""
+        return self.status == "draining"
 
     @property
     def load(self) -> float:
@@ -244,6 +254,10 @@ class ScrapeLoop:
         self.timeout = timeout
         self.unreachable_after = max(1, int(unreachable_after))
         self.on_snapshot = on_snapshot
+        # Extra observers (autoscaler, tests) ride the same tick as the
+        # router's on_snapshot callback; each is isolated — one raising
+        # observer must not starve the others or kill the plane.
+        self._observers: list = []
         self._lock = threading.Lock()
         self._snapshots: dict[int, ReplicaSnapshot] = {}
         self._stop = threading.Event()
@@ -314,12 +328,19 @@ class ScrapeLoop:
         with self._lock:
             self._snapshots = fresh
             self.ticks += 1
-        if self.on_snapshot is not None:
+            observers = list(self._observers)
+        for obs in ([self.on_snapshot] if self.on_snapshot else []) + observers:
             try:
-                self.on_snapshot(dict(fresh))
+                obs(dict(fresh))
             except Exception:
                 pass  # observer must never kill the plane
         return fresh
+
+    def add_observer(self, fn) -> None:
+        """Register an extra per-tick observer (called with a copy of the
+        fresh snapshot map, after ``on_snapshot``)."""
+        with self._lock:
+            self._observers.append(fn)
 
     # -- consumers -----------------------------------------------------------
     def snapshots(self) -> dict[int, ReplicaSnapshot]:

@@ -27,6 +27,7 @@ the atexit/conftest sweeps reap leftovers from a crashed driver.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -106,6 +107,11 @@ class ReplicaGang:
         self._restart_at: dict[int, float] = {}  # rank -> not-before time
         self.restarts: dict[int, int] = {r: 0 for r in range(num_replicas)}
         self.exhausted: set[int] = set()
+        # Dynamic membership (the autoscaler's levers): a retiring rank
+        # sits in ``_retiring`` (rank -> kill-backstop deadline) until its
+        # process exits, then moves to ``retired`` after sidecar cleanup.
+        self._retiring: dict[int, float] = {}
+        self.retired: set[int] = set()
         self._stop = threading.Event()
         self._supervisor: threading.Thread | None = None
         os.makedirs(self.workdir, exist_ok=True)
@@ -173,6 +179,12 @@ class ReplicaGang:
 
     # -- spawn/supervise -----------------------------------------------------
     def _spawn(self, rank: int) -> None:
+        # A stale drain marker for this rank id would make the fresh
+        # replica retire itself on its first poll — scrub it first.
+        try:
+            os.unlink(os.path.join(self.workdir, f"fleet_drain_rank{rank}"))
+        except OSError:
+            pass
         heartbeat_path = os.path.join(self.workdir, f"heartbeat_{rank}")
         env = dict(os.environ)
         for name in _RENDEZVOUS_ENV:
@@ -225,6 +237,25 @@ class ReplicaGang:
                 ranks = dict(self._procs)
             for rank, proc in ranks.items():
                 dead = proc.poll() is not None
+                backstop = self._retiring.get(rank)
+                if backstop is not None:
+                    # Deliberate retirement: never restart. Finalize on
+                    # exit, or SIGKILL past the drain-deadline backstop
+                    # (a wedged replica must not block the scale-down).
+                    if dead:
+                        self._finalize_retirement(rank, proc)
+                    elif now >= backstop:
+                        log.warning(
+                            "replica %d missed its drain deadline; "
+                            "killing to finish retirement", rank,
+                        )
+                        _signal_proc(proc, signal.SIGKILL)
+                        try:
+                            proc.wait(timeout=10.0)
+                        except subprocess.TimeoutExpired:
+                            pass
+                        self._finalize_retirement(rank, proc)
+                    continue
                 stalled = (
                     not dead
                     and self.heartbeat_timeout is not None
@@ -277,6 +308,101 @@ class ReplicaGang:
             # young — exit detection covers a worker that died pre-beat.
             return 0.0
 
+    # -- dynamic membership (the autoscaler's levers) ------------------------
+    def add_rank(self) -> int:
+        """Scale up by one: spawn a fresh replica on the lowest free rank
+        id. A reused id (previously retired or exhausted) starts clean —
+        restart budget reset, stale sidecars/markers scrubbed — so an old
+        rank's history can't haunt its successor."""
+        with self._lock:
+            taken = set(self._procs) | set(self._retiring)
+            rank = 0
+            while rank in taken:
+                rank += 1
+        self.retired.discard(rank)
+        self.exhausted.discard(rank)
+        self.restarts[rank] = 0
+        self._restart_at.pop(rank, None)
+        self._cleanup_rank_files(rank)
+        self._spawn(rank)
+        log.info("replica %d added (scale-up)", rank)
+        return rank
+
+    def retire_rank(
+        self, rank: int, *, drain: bool = True, deadline_s: float = 30.0
+    ) -> bool:
+        """Scale down by one: mark ``rank`` draining (marker file → the
+        replica 503s new work, finishes in-flight, exits) and hand it to
+        the supervisor for finalization. ``drain=False`` kills it
+        outright. Returns False if the rank isn't live."""
+        with self._lock:
+            proc = self._procs.get(rank)
+            if proc is None or rank in self._retiring:
+                return False
+            # Backstop is the replica's own deadline plus slack for its
+            # exit path; the supervisor SIGKILLs past it.
+            self._retiring[rank] = (
+                time.monotonic() + (deadline_s if drain else 0.0) + 10.0
+            )
+        if not drain or proc.poll() is not None:
+            _signal_proc(proc, signal.SIGKILL)
+            return True
+        marker = os.path.join(self.workdir, f"fleet_drain_rank{rank}")
+        try:
+            tmp = f"{marker}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"deadline": time.time() + deadline_s,
+                           "rank": rank}, f)
+                f.write("\n")
+            os.replace(tmp, marker)
+        except OSError:
+            # Can't signal the drain — kill rather than leak the rank.
+            _signal_proc(proc, signal.SIGKILL)
+        log.info(
+            "replica %d retiring (drain deadline %.1fs)", rank, deadline_s
+        )
+        return True
+
+    def reap_rank(self, rank: int) -> bool:
+        """Absorb a permanently-dead rank (restart budget exhausted) as an
+        observed scale-down: scrub its sidecars so discovery drops it and
+        the router purges its routing state. The rank id becomes free for
+        reuse by a later ``add_rank``. Returns False unless the rank is
+        actually down for good."""
+        with self._lock:
+            if rank in self._procs or rank in self._retiring:
+                return False
+        if rank not in self.exhausted and rank not in self.retired:
+            return False
+        self.retired.add(rank)
+        self._cleanup_rank_files(rank)
+        log.info("replica %d reaped (observed scale-down)", rank)
+        return True
+
+    def _finalize_retirement(self, rank: int, proc) -> None:
+        _unregister_gang([proc])
+        with self._lock:
+            self._procs.pop(rank, None)
+            self._retiring.pop(rank, None)
+        self.retired.add(rank)
+        self._cleanup_rank_files(rank)
+        log.info("replica %d retired (exit=%s)", rank, proc.returncode)
+
+    def _cleanup_rank_files(self, rank: int) -> None:
+        """Remove one rank's discovery/heartbeat droppings so a retired
+        rank vanishes from the scrape plane and a reused id starts
+        clean."""
+        for name in (
+            f"fleet_rank{rank}.json",
+            f"http_rank{rank}.json",
+            f"heartbeat_{rank}",
+            f"fleet_drain_rank{rank}",
+        ):
+            try:
+                os.unlink(os.path.join(self.workdir, name))
+            except OSError:
+                pass
+
     # -- drill hooks / introspection -----------------------------------------
     def kill_rank(self, rank: int) -> bool:
         """SIGKILL one replica's process group (the fault-drill lever).
@@ -295,11 +421,22 @@ class ReplicaGang:
                 for rank, proc in sorted(self._procs.items())
             }
 
+    def live_ranks(self) -> list[int]:
+        """Ranks with a running process that are *not* mid-retirement —
+        the autoscaler's notion of current fleet size."""
+        with self._lock:
+            return sorted(
+                rank for rank, proc in self._procs.items()
+                if proc.poll() is None and rank not in self._retiring
+            )
+
     def status(self) -> dict:
         return {
             "num_replicas": self.num_replicas,
             "alive": self.alive(),
             "restarts": dict(self.restarts),
             "exhausted": sorted(self.exhausted),
+            "retiring": sorted(self._retiring),
+            "retired": sorted(self.retired),
             "workdir": self.workdir,
         }

@@ -361,6 +361,59 @@ register(
     description="In-flight cap for the `batch` SLO tier.",
 )
 
+# fleet autoscaling (closed loop: SLO burn / queue depth -> replica count)
+register(
+    "MLSPARK_AUTOSCALE_MIN_REPLICAS", type="int", default=1, subsystem="autoscale",
+    description="Floor on the autoscaler's replica target; scale-down "
+    "never drains below this.",
+)
+register(
+    "MLSPARK_AUTOSCALE_MAX_REPLICAS", type="int", default=8, subsystem="autoscale",
+    description="Ceiling on the autoscaler's replica target; scale-up "
+    "never spawns past this.",
+)
+register(
+    "MLSPARK_AUTOSCALE_BURN_UP", type="float", default=0.1, subsystem="autoscale",
+    description="Scale up when any tier's SLO burn EWMA (scraped replica "
+    "rollup or router-side gauge) is at/above this miss fraction.",
+)
+register(
+    "MLSPARK_AUTOSCALE_BURN_DOWN", type="float", default=0.01, subsystem="autoscale",
+    description="Burn EWMA must be at/below this before the load signal "
+    "may vote to scale down (both signals must be cold).",
+)
+register(
+    "MLSPARK_AUTOSCALE_QUEUE_UP", type="float", default=4.0, subsystem="autoscale",
+    description="Scale up when mean in-flight per healthy replica is "
+    "at/above this depth.",
+)
+register(
+    "MLSPARK_AUTOSCALE_QUEUE_DOWN", type="float", default=1.0, subsystem="autoscale",
+    description="Mean in-flight per healthy replica must be at/below "
+    "this before a scale-down vote counts.",
+)
+register(
+    "MLSPARK_AUTOSCALE_HYSTERESIS_TICKS", type="int", default=2, subsystem="autoscale",
+    description="Consecutive scrape ticks a signal must hold before the "
+    "autoscaler acts on it (one bad scrape cannot thrash the fleet).",
+)
+register(
+    "MLSPARK_AUTOSCALE_COOLDOWN_S", type="float", default=5.0, subsystem="autoscale",
+    description="Minimum seconds between autoscale actions (either "
+    "direction); the anti-thrash backstop behind hysteresis.",
+)
+register(
+    "MLSPARK_AUTOSCALE_DRAIN_DEADLINE_S", type="float", default=30.0, subsystem="autoscale",
+    description="Seconds a draining replica gets to retire its in-flight "
+    "work before it is torn down anyway.",
+)
+register(
+    "MLSPARK_AUTOSCALE_DRAIN_BATCH_SHED", type="float", default=0.5, subsystem="autoscale",
+    description="While a drain is in progress the batch tier's admission "
+    "budget is multiplied by this factor (interactive is untouched) so "
+    "shed capacity comes out of batch work first.",
+)
+
 # fault injection
 register(
     "MLSPARK_FAULTS", type="spec", default=None, subsystem="faults",

@@ -1,0 +1,703 @@
+"""fleet/autoscaler.py: the closed loop over burn, queue depth, and
+membership (docs/FLEET.md "Autoscaling").
+
+The control law is unit-tested against a ``FakeGang`` and synthetic
+``ReplicaSnapshot`` maps (no processes, no sockets): triggers,
+hysteresis, cooldown, clamps, coldest-victim selection, drain
+completion, observed scale-down, and the every-decision-carries-its-
+inputs contract. ``ScrapeLoop`` membership churn (rank retired/added
+mid-tick, the unreachable grace vs a deliberate drain) runs against a
+real loop over a sidecar dir with a scripted ``snapshot_replica``. The
+router's vanished-rank purge and ``ReplicaGang`` rank-id reuse rules are
+tested at the unit layer, and the whole loop rides
+``tools/fleet_drill.py --smoke`` (2→3→2 on the tiny model) as the tier-1
+subprocess entry.
+"""
+
+import json
+import os
+import types
+
+import pytest
+
+from machine_learning_apache_spark_tpu.fleet import (
+    AutoscaleConfig,
+    FleetAdmission,
+    FleetAutoscaler,
+    FleetBackpressure,
+    FleetRouter,
+    ReplicaSnapshot,
+    SLOTier,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+def snap(rank, *, healthy=True, status=None, in_flight=0, ewma=0.0):
+    if status is None:
+        status = "ok" if healthy else "degraded"
+    return ReplicaSnapshot(
+        rank=rank,
+        port=10000 + rank,
+        healthy=healthy,
+        status=status,
+        in_flight=in_flight,
+        queue_depth=0,
+        slo={"interactive": {"ewma": ewma, "window_count": 10,
+                             "window_missed": int(10 * ewma),
+                             "total": 10, "missed": int(10 * ewma)}},
+    )
+
+
+class FakeGang:
+    """The membership API the autoscaler drives, with recorded calls.
+    ``live_ranks`` mirrors the real gang's semantics: a retiring rank is
+    no longer live even though its process may still be draining."""
+
+    def __init__(self, ranks=(0, 1)):
+        self._live = set(ranks)
+        self.exhausted = set()
+        self.retired = set()
+        self.added = []
+        self.retire_calls = []
+        self.reaped = []
+
+    def live_ranks(self):
+        return sorted(self._live)
+
+    def add_rank(self):
+        rank = 0
+        while rank in self._live:
+            rank += 1
+        self._live.add(rank)
+        self.added.append(rank)
+        return rank
+
+    def retire_rank(self, rank, *, drain=True, deadline_s=None):
+        if rank not in self._live:
+            return False
+        self.retire_calls.append((rank, drain, deadline_s))
+        self._live.discard(rank)
+        return True
+
+    def reap_rank(self, rank):
+        if rank in self._live:
+            return False
+        self.reaped.append(rank)
+        self.retired.add(rank)
+        return True
+
+
+class FakeAdmission:
+    def __init__(self):
+        self.sheds = []
+        self.unsheds = []
+
+    def shed(self, tier, factor):
+        self.sheds.append((tier, factor))
+
+    def unshed(self, tier):
+        self.unsheds.append(tier)
+
+
+def cfg(**kw):
+    base = dict(
+        min_replicas=1, max_replicas=4, burn_up=0.1, burn_down=0.01,
+        queue_up=4.0, queue_down=1.0, hysteresis_ticks=2, cooldown_s=5.0,
+        drain_deadline_s=20.0, drain_batch_shed=0.5,
+    )
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+# -- the control law ----------------------------------------------------------
+class TestScaleUp:
+    def test_queue_trigger_after_hysteresis(self):
+        gang = FakeGang({0, 1})
+        scaler = FleetAutoscaler(gang, config=cfg(cooldown_s=0.0))
+        hot = {0: snap(0, in_flight=6), 1: snap(1, in_flight=6)}
+        out = scaler.observe(hot)
+        assert out["action"] == "hold_hysteresis"
+        assert gang.added == []
+        out = scaler.observe(hot)
+        assert out["action"] == "scale_up"
+        assert gang.added == [2]
+        assert scaler.scale_ups == 1
+
+    def test_burn_trigger(self):
+        gang = FakeGang({0})
+        scaler = FleetAutoscaler(
+            gang, config=cfg(hysteresis_ticks=1, cooldown_s=0.0)
+        )
+        out = scaler.observe({0: snap(0, in_flight=0, ewma=0.5)})
+        assert out["action"] == "scale_up"
+        assert out["burn"] == 0.5
+
+    def test_one_cold_tick_resets_hysteresis(self):
+        gang = FakeGang({0})
+        scaler = FleetAutoscaler(gang, config=cfg(cooldown_s=0.0))
+        hot = {0: snap(0, in_flight=9)}
+        mid = {0: snap(0, in_flight=2)}  # between the bands
+        scaler.observe(hot)
+        scaler.observe(mid)
+        out = scaler.observe(hot)
+        assert out["action"] == "hold_hysteresis"
+        assert gang.added == []
+
+    def test_cooldown_blocks_back_to_back(self):
+        clock = FakeClock()
+        gang = FakeGang({0})
+        scaler = FleetAutoscaler(
+            gang, config=cfg(hysteresis_ticks=1, cooldown_s=10.0),
+            clock=clock,
+        )
+        hot = {0: snap(0, in_flight=9)}
+        assert scaler.observe(hot)["action"] == "scale_up"
+        assert scaler.observe(hot)["action"] == "hold_cooldown"
+        assert gang.added == [1]
+        clock.now += 11.0
+        assert scaler.observe(hot)["action"] == "scale_up"
+        assert gang.added == [1, 2]
+
+    def test_max_replicas_clamp(self):
+        gang = FakeGang({0, 1})
+        scaler = FleetAutoscaler(
+            gang,
+            config=cfg(max_replicas=2, hysteresis_ticks=1, cooldown_s=0.0),
+        )
+        out = scaler.observe({0: snap(0, in_flight=9),
+                              1: snap(1, in_flight=9)})
+        assert out["action"] == "hold_at_max"
+        assert gang.added == []
+
+
+class TestScaleDown:
+    def make(self, ranks=(0, 1, 2), **kw):
+        gang = FakeGang(set(ranks))
+        admission = FakeAdmission()
+        scaler = FleetAutoscaler(
+            gang,
+            config=cfg(hysteresis_ticks=1, cooldown_s=0.0, **kw),
+            admission=admission,
+        )
+        return gang, admission, scaler
+
+    def test_picks_coldest_and_sheds_batch(self):
+        gang, admission, scaler = self.make()
+        cold = {0: snap(0, in_flight=2), 1: snap(1, in_flight=0),
+                2: snap(2, in_flight=1)}
+        out = scaler.observe(cold)
+        assert out["action"] == "scale_down_start"
+        assert gang.retire_calls == [(1, True, 20.0)]
+        assert admission.sheds == [("batch", 0.5)]
+        decision = scaler.decisions[-1]
+        assert decision["action"] == "scale_down_start"
+        assert decision["rank"] == 1
+        assert decision["target"] == 2
+
+    def test_drain_completion_unsheds_and_counts(self):
+        gang, admission, scaler = self.make()
+        cold = {0: snap(0), 1: snap(1), 2: snap(2)}
+        scaler.observe(cold)
+        victim = gang.retire_calls[0][0]
+        # The drained rank vanished from discovery (gang scrubbed its
+        # sidecars) — the next tick closes out the scale-down.
+        after = {r: snap(r) for r in (0, 1, 2) if r != victim}
+        scaler.observe(after)
+        assert scaler.scale_downs == 1
+        assert admission.unsheds == ["batch"]
+        actions = [d["action"] for d in scaler.decisions]
+        assert "scale_down_complete" in actions
+
+    def test_one_drain_at_a_time(self):
+        gang, _, scaler = self.make()
+        cold = {0: snap(0), 1: snap(1), 2: snap(2)}
+        scaler.observe(cold)
+        assert len(gang.retire_calls) == 1
+        # Victim still scrapes (draining) — no second drain may start.
+        out = scaler.observe(cold)
+        assert out["action"] == "hold_draining"
+        assert len(gang.retire_calls) == 1
+
+    def test_min_replicas_clamp(self):
+        gang, _, scaler = self.make(ranks=(0,))
+        out = scaler.observe({0: snap(0)})
+        assert out["action"] == "hold_at_min"
+        assert gang.retire_calls == []
+
+    def test_never_drains_last_healthy_replica(self):
+        # Warming/unhealthy ranks cannot serve yet — retiring the only
+        # healthy replica would leave zero serving capacity, so the
+        # loop must hold instead of draining it.
+        gang, _, scaler = self.make(ranks=(0, 1, 2))
+        snaps = {
+            0: snap(0),
+            1: snap(1, healthy=False, status="degraded"),
+            2: snap(2, healthy=False, status="unreachable"),
+        }
+        out = scaler.observe(snaps)
+        assert out["action"] == "hold_last_healthy"
+        assert gang.retire_calls == []
+        assert scaler.decisions[-1]["action"] == "hold_last_healthy"
+
+    def test_draining_replica_not_load_bearing(self):
+        # A draining replica's in-flight must not count toward the queue
+        # signal (it is leaving, not capacity) nor be picked as victim.
+        gang, _, scaler = self.make(ranks=(0, 1))
+        snaps = {
+            0: snap(0, in_flight=0),
+            1: snap(1, in_flight=50, status="draining", healthy=False),
+        }
+        out = scaler.observe(snaps)
+        assert out["queue_depth"] == 0.0
+        assert out["healthy"] == 1
+
+
+class TestObservedScaleDown:
+    def test_exhausted_rank_reaped_and_logged(self):
+        gang = FakeGang({0, 2})
+        gang.exhausted = {1}
+        scaler = FleetAutoscaler(gang, config=cfg(min_replicas=2))
+        out = scaler.observe({0: snap(0), 2: snap(2)})
+        assert gang.reaped == [1]
+        assert scaler.observed_scale_downs == 1
+        d = next(d for d in scaler.decisions
+                 if d["action"] == "observed_scale_down")
+        assert d["rank"] == 1
+        assert d["target"] == 2
+        assert out["live"] == 2
+
+    def test_reap_is_idempotent_across_ticks(self):
+        gang = FakeGang({0})
+        gang.exhausted = {1}
+        scaler = FleetAutoscaler(gang, config=cfg())
+        scaler.observe({0: snap(0)})
+        scaler.observe({0: snap(0)})
+        assert gang.reaped == [1]
+        assert scaler.observed_scale_downs == 1
+
+
+class TestDecisionLog:
+    def test_every_decision_carries_inputs(self):
+        clock = FakeClock()
+        gang = FakeGang({0, 1})
+        scaler = FleetAutoscaler(
+            gang, config=cfg(hysteresis_ticks=1, cooldown_s=5.0),
+            admission=FakeAdmission(), clock=clock,
+        )
+        hot = {0: snap(0, in_flight=9), 1: snap(1, in_flight=9)}
+        cold = {r: snap(r) for r in gang.live_ranks()}
+        scaler.observe(hot)           # scale_up
+        scaler.observe(hot)           # hold_cooldown
+        clock.now += 6.0
+        gang.exhausted = {0}
+        gang._live.discard(0)
+        scaler.observe(cold)          # observed_scale_down (+ maybe more)
+        clock.now += 6.0
+        cold = {r: snap(r) for r in gang.live_ranks()}
+        scaler.observe(cold)
+        scaler.observe(cold)          # scale_down_start
+        assert scaler.decisions
+        for d in scaler.decisions:
+            for key in ("action", "burn", "queue_depth", "live", "target"):
+                assert key in d, (key, d)
+
+    def test_decisions_land_as_annotations(self):
+        from machine_learning_apache_spark_tpu.telemetry import (
+            events as _events,
+        )
+
+        _events.set_enabled(True)
+        try:
+            log = _events.get_log()
+            before = len(
+                [e for e in log.snapshot()
+                 if e.kind == "annotation" and e.name == "fleet.autoscaler"]
+            )
+            gang = FakeGang({0})
+            scaler = FleetAutoscaler(
+                gang, config=cfg(hysteresis_ticks=1, cooldown_s=0.0)
+            )
+            scaler.observe({0: snap(0, in_flight=9)})
+            auto = [
+                e for e in log.snapshot()
+                if e.kind == "annotation" and e.name == "fleet.autoscaler"
+            ]
+            assert len(auto) == before + 1
+            attrs = auto[-1].attrs or {}
+            assert attrs.get("action") == "scale_up"
+            assert "burn" in attrs and "queue_depth" in attrs
+            assert "target" in attrs
+        finally:
+            _events.set_enabled(None)  # re-arm the env read
+
+
+class TestConfig:
+    def test_from_env_reads_registered_knobs(self, monkeypatch):
+        monkeypatch.setenv("MLSPARK_AUTOSCALE_MIN_REPLICAS", "2")
+        monkeypatch.setenv("MLSPARK_AUTOSCALE_MAX_REPLICAS", "6")
+        monkeypatch.setenv("MLSPARK_AUTOSCALE_BURN_UP", "0.3")
+        monkeypatch.setenv("MLSPARK_AUTOSCALE_COOLDOWN_S", "1.5")
+        c = AutoscaleConfig.from_env()
+        assert c.min_replicas == 2
+        assert c.max_replicas == 6
+        assert c.burn_up == 0.3
+        assert c.cooldown_s == 1.5
+        assert c.drain_deadline_s == 30.0  # registry default
+
+    def test_inverted_bands_rejected(self):
+        with pytest.raises(ValueError, match="burn_down"):
+            cfg(burn_down=0.5, burn_up=0.1)
+        with pytest.raises(ValueError, match="queue_down"):
+            cfg(queue_down=9.0, queue_up=4.0)
+        with pytest.raises(ValueError, match="min_replicas"):
+            cfg(min_replicas=0)
+        with pytest.raises(ValueError, match="max_replicas"):
+            cfg(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="drain_batch_shed"):
+            cfg(drain_batch_shed=0.0)
+
+
+# -- admission shed (the drain-time batch lever) ------------------------------
+class TestAdmissionShed:
+    def tiers(self):
+        return {
+            "interactive": SLOTier("interactive", deadline_s=10.0,
+                                   max_in_flight=4),
+            "batch": SLOTier("batch", deadline_s=120.0, max_in_flight=4),
+        }
+
+    def test_shed_halves_batch_cap_only(self):
+        adm = FleetAdmission(self.tiers(), tenant_max_in_flight=None)
+        adm.shed("batch", 0.5)
+        leases = [adm.admit(tier="batch") for _ in range(2)]
+        with pytest.raises(FleetBackpressure):
+            adm.admit(tier="batch")
+        # Interactive keeps its full budget.
+        for _ in range(4):
+            adm.admit(tier="interactive")
+        stats = adm.stats()["tiers"]
+        assert stats["batch"]["effective_max_in_flight"] == 2
+        assert stats["batch"]["shed_factor"] == 0.5
+        assert stats["interactive"]["effective_max_in_flight"] == 4
+        for lease in leases:
+            adm.release(lease)
+
+    def test_unshed_restores_and_floor_is_one(self):
+        adm = FleetAdmission(self.tiers(), tenant_max_in_flight=None)
+        adm.shed("batch", 0.01)  # floor: never closes the tier
+        adm.admit(tier="batch")
+        with pytest.raises(FleetBackpressure):
+            adm.admit(tier="batch")
+        adm.unshed("batch")
+        adm.admit(tier="batch")  # full cap back
+        with pytest.raises(ValueError):
+            adm.shed("nope", 0.5)
+        with pytest.raises(ValueError):
+            adm.shed("batch", 0.0)
+
+
+# -- ScrapeLoop membership churn (satellite: churn coverage) ------------------
+class ScriptedScrape:
+    """Replaces ``snapshot_replica``: per-rank scripted status, so churn
+    tests drive the loop without sockets."""
+
+    def __init__(self):
+        self.status = {}  # rank -> status string
+
+    def __call__(self, rank, port, *, timeout=2.0, retries=0):
+        status = self.status.get(rank, "ok")
+        s = ReplicaSnapshot(rank=rank, port=port, status=status)
+        if status != "unreachable":
+            s.healthy = status == "ok"
+            s.in_flight = 1
+        return s
+
+
+@pytest.fixture()
+def scripted_loop(tmp_path, monkeypatch):
+    import importlib
+
+    # The package re-exports a ``scrape`` *function* that shadows the
+    # submodule attribute — resolve the module itself to patch it.
+    smod = importlib.import_module(
+        "machine_learning_apache_spark_tpu.fleet.scrape"
+    )
+    scripted = ScriptedScrape()
+    monkeypatch.setattr(smod, "snapshot_replica", scripted)
+
+    def sidecar(rank):
+        path = tmp_path / f"fleet_rank{rank}.json"
+        path.write_text(json.dumps({"port": 10000 + rank, "rank": rank}))
+        return path
+
+    loop = smod.ScrapeLoop(str(tmp_path), unreachable_after=2)
+    return loop, scripted, sidecar, tmp_path
+
+
+class TestScrapeLoopChurn:
+    def test_rank_retired_mid_tick_drops_from_snapshots(self, scripted_loop):
+        loop, _, sidecar, tmp_path = scripted_loop
+        sidecar(0)
+        p1 = sidecar(1)
+        assert sorted(loop.tick()) == [0, 1]
+        p1.unlink()  # gang finalized the retirement: sidecars scrubbed
+        assert sorted(loop.tick()) == [0]
+        # No ghost: the dropped rank must not linger via the grace path.
+        assert 1 not in loop.snapshots()
+
+    def test_rank_added_mid_tick_appears(self, scripted_loop):
+        loop, _, sidecar, _ = scripted_loop
+        sidecar(0)
+        assert sorted(loop.tick()) == [0]
+        sidecar(1)  # scale-up: the new replica published its port
+        snaps = loop.tick()
+        assert sorted(snaps) == [0, 1]
+        assert snaps[1].healthy
+
+    def test_draining_is_not_a_failure_signal(self, scripted_loop):
+        loop, scripted, sidecar, _ = scripted_loop
+        sidecar(0)
+        scripted.status[0] = "draining"
+        s = loop.tick()[0]
+        # Unhealthy for dispatch, but a live answer: no grace burned,
+        # and the draining property is visible to membership accounting.
+        assert s.draining and not s.healthy
+        assert s.status == "draining"
+        assert s.consecutive_failures == 0
+        s = loop.tick()[0]
+        assert s.draining and s.consecutive_failures == 0
+
+    def test_grace_keeps_draining_status_not_double_unhealthy(
+        self, scripted_loop
+    ):
+        # Drain then exit: while the sidecar lingers (pre-finalization)
+        # the unreachable grace must report the *deliberate* state —
+        # "draining" — not flip the rank to a failure-counted unknown.
+        loop, scripted, sidecar, _ = scripted_loop
+        sidecar(0)
+        scripted.status[0] = "draining"
+        assert loop.tick()[0].draining
+        scripted.status[0] = "unreachable"  # process exited
+        s = loop.tick()[0]
+        assert s.status == "draining"  # grace keeps last-known state
+        assert s.consecutive_failures == 1
+        s = loop.tick()[0]  # window closes
+        assert s.status == "unreachable"
+        assert s.consecutive_failures == 2
+
+    def test_observers_ride_every_tick_isolated(self, scripted_loop):
+        loop, _, sidecar, _ = scripted_loop
+        sidecar(0)
+        seen = []
+
+        def bad(_):
+            raise RuntimeError("observer must never kill the plane")
+
+        loop.add_observer(bad)
+        loop.add_observer(lambda snaps: seen.append(sorted(snaps)))
+        loop.tick()
+        loop.tick()
+        assert seen == [[0], [0]]
+
+
+# -- router purge of vanished ranks (satellite: stale-entry bugfix) -----------
+class TestRouterVanishedRankPurge:
+    def make_router(self, snaps):
+        holder = {"snaps": snaps}
+        router = FleetRouter(
+            snapshot_source=lambda: dict(holder["snaps"]),
+            policy="affinity",
+        )
+        return router, holder
+
+    def test_penalty_box_and_affinity_purged_when_rank_vanishes(self):
+        s0, s1 = snap(0), snap(1)
+        s1.prefix_digests = frozenset({"d1"})
+        router, holder = self.make_router({0: s0, 1: s1})
+        router._on_scrape({0: s0, 1: s1})
+        assert 1 in router.affinity.candidates("d1")
+        router._box(1)
+        assert 1 in router._down
+        # Rank 1 retires: gang scrubs its sidecars, discovery drops it.
+        holder["snaps"] = {0: s0}
+        router._on_scrape({0: s0})
+        assert 1 not in router._down
+        assert 1 not in router.affinity.candidates("d1")
+        # A future rank reusing the slot starts with a clean sheet.
+        assert 1 not in router.affinity.stats()["ranks_with_residency"]
+
+    def test_routing_memory_purged_too(self):
+        s0, s1 = snap(0), snap(1)
+        router, holder = self.make_router({0: s0, 1: s1})
+        router._on_scrape({0: s0, 1: s1})
+        router.affinity.note_routed("digest-x", 1)
+        assert 1 in router.affinity.candidates("digest-x")
+        holder["snaps"] = {0: s0}
+        router._on_scrape({0: s0})
+        assert 1 not in router.affinity.candidates("digest-x")
+
+
+# -- ReplicaGang membership unit rules (no processes) -------------------------
+class TestGangMembershipRules:
+    def make_gang(self, tmp_path, monkeypatch, ranks=(0, 1)):
+        from machine_learning_apache_spark_tpu.launcher.replica_gang import (
+            ReplicaGang,
+        )
+
+        spawned = []
+        monkeypatch.setattr(
+            ReplicaGang, "_spawn",
+            lambda self, rank: spawned.append(rank),
+        )
+        gang = ReplicaGang(
+            "os:getcwd", num_replicas=len(ranks), workdir=str(tmp_path),
+        )
+        for r in ranks:
+            gang._procs[r] = types.SimpleNamespace(
+                poll=lambda: None, returncode=None, pid=990000 + r,
+            )
+        return gang, spawned
+
+    def test_add_rank_picks_lowest_free_id(self, tmp_path, monkeypatch):
+        gang, spawned = self.make_gang(tmp_path, monkeypatch, ranks=(0, 2))
+        assert gang.add_rank() == 1
+        assert spawned == [1]
+
+    def test_reused_id_starts_clean(self, tmp_path, monkeypatch):
+        gang, spawned = self.make_gang(tmp_path, monkeypatch, ranks=(0,))
+        gang.exhausted.add(1)
+        gang.retired.add(1)
+        gang.restarts[1] = 2
+        gang._restart_at[1] = 999.0
+        stale = tmp_path / "fleet_rank1.json"
+        stale.write_text("{}")
+        assert gang.add_rank() == 1
+        assert 1 not in gang.exhausted
+        assert 1 not in gang.retired
+        assert gang.restarts[1] == 0
+        assert 1 not in gang._restart_at
+        assert not stale.exists()
+
+    def test_retire_rank_writes_drain_marker(self, tmp_path, monkeypatch):
+        gang, _ = self.make_gang(tmp_path, monkeypatch)
+        assert gang.retire_rank(1, drain=True, deadline_s=5.0)
+        marker = tmp_path / "fleet_drain_rank1"
+        assert marker.exists()
+        payload = json.loads(marker.read_text())
+        assert payload["rank"] == 1
+        assert payload["deadline"] > 0
+        # A retiring rank is no longer live, and can't retire twice.
+        assert gang.live_ranks() == [0]
+        assert not gang.retire_rank(1)
+        assert not gang.retire_rank(7)  # unknown rank
+
+    def test_reap_requires_permanent_death(self, tmp_path, monkeypatch):
+        gang, _ = self.make_gang(tmp_path, monkeypatch, ranks=(0,))
+        assert not gang.reap_rank(0)  # still live
+        assert not gang.reap_rank(1)  # unknown, never exhausted
+        gang.exhausted.add(1)
+        side = tmp_path / "fleet_rank1.json"
+        side.write_text("{}")
+        assert gang.reap_rank(1)
+        assert 1 in gang.retired
+        assert not side.exists()
+
+    def test_finalize_retirement_scrubs_files(self, tmp_path, monkeypatch):
+        gang, _ = self.make_gang(tmp_path, monkeypatch)
+        for name in ("fleet_rank1.json", "http_rank1.json",
+                     "heartbeat_1", "fleet_drain_rank1"):
+            (tmp_path / name).write_text("{}")
+        proc = gang._procs[1]
+        gang._retiring[1] = 0.0
+        gang._finalize_retirement(1, proc)
+        assert 1 not in gang._procs
+        assert 1 not in gang._retiring
+        assert 1 in gang.retired
+        for name in ("fleet_rank1.json", "http_rank1.json",
+                     "heartbeat_1", "fleet_drain_rank1"):
+            assert not (tmp_path / name).exists(), name
+
+
+# -- replica data plane: draining front door ----------------------------------
+class TestReplicaDraining:
+    def test_healthz_and_generate_refuse_while_draining(self, tmp_path):
+        import urllib.error
+        import urllib.request
+
+        from machine_learning_apache_spark_tpu.fleet import ReplicaServer
+
+        engine = types.SimpleNamespace()  # never touched while draining
+        server = ReplicaServer(
+            engine, rank=0, port=0, health_fn=lambda: True,
+        )
+        server.start(directory=str(tmp_path))
+        try:
+            server.set_draining(True)
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/healthz", timeout=5
+                )
+            assert exc.value.code == 503
+            payload = json.loads(exc.value.read().decode())
+            assert payload["status"] == "draining"
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/generate",
+                data=json.dumps({"text": "hi"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=5)
+            assert exc.value.code == 503
+            body = json.loads(exc.value.read().decode())
+            assert body["error"] == "replica draining"
+            assert server.stats()["refused_503"] == 1
+        finally:
+            server.stop()
+
+
+# -- end-to-end: the 2→3→2 autoscale cycle (tier-1 CI entry) ------------------
+def test_fleet_drill_smoke_subprocess(tmp_path):
+    """tools/fleet_drill.py --smoke: real gang + router + autoscaler;
+    closed-loop load trips the queue trigger (2→3), removing it trips
+    the coldest-replica drain (3→2); ledger conserves and every decision
+    carries its inputs."""
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "fleet_drill_smoke.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo_root, "tools", "fleet_drill.py"),
+            "--smoke", "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    artifact = json.loads(out.read_text())
+    assert artifact["ok"] is True
+    assert artifact["gates"] == {
+        "scaled_up_2_to_3": True,
+        "scaled_down_3_to_2": True,
+        "replacement_rank_serves": True,
+        "zero_lost_non_in_flight": True,
+        "decisions_carry_inputs": True,
+    }
+    assert artifact["conservation"]["router_ledger"]["in_flight"] == 0
+    # The host-load preflight must be stamped (PR 13/15 caveat).
+    assert "host_load" in artifact and "contended" in artifact
+    actions = [d["action"] for d in artifact["decisions"]]
+    assert "scale_up" in actions
+    assert "scale_down_start" in actions
+    assert "scale_down_complete" in actions

@@ -1,4 +1,4 @@
-"""Fault drill — run the injection scenarios end to end, emit FAULTS_r04.json.
+"""Fault drill — run the injection scenarios end to end, emit FAULTS_r05.json.
 
 The executable form of docs/FAULT_TOLERANCE.md: each scenario arms a
 deterministic fault plan (``utils.faults``), runs the real subsystem
@@ -20,6 +20,12 @@ against it, and records what the robustness layer did about it:
   surviving replica keeps serving through the outage, the router drains
   around the dead rank, the ``ReplicaGang`` supervisor restarts it, and
   post-recovery traffic reaches it again.
+- ``preemption_as_scale_down`` (round 5) — a 3-replica fleet with a
+  zero restart budget loses rank 1 permanently under mixed-tier load;
+  the ``FleetAutoscaler`` must absorb the death as an observed
+  scale-down (corpse reaped, router state purged, decision logged with
+  its inputs), exactly the victim's in-flight is lost, the ledger
+  conserves, and the interactive tier is never starved.
 - ``elastic_shrink`` (round 4) — an 8-rank training gang loses rank 7
   PERMANENTLY (restart budget 0), shrinks to 7 and elastically resumes
   from the group-durable checkpoint via cross-topology resharding
@@ -37,7 +43,7 @@ recorded in the artifact.
 
 Usage::
 
-    python tools/fault_drill.py [--out FAULTS_r04.json] [scenario ...]
+    python tools/fault_drill.py [--out FAULTS_r05.json] [scenario ...]
 
 Exits nonzero if any scenario's invariant does not hold, so CI can gate
 on the drill the way it gates on the test suite.
@@ -388,6 +394,163 @@ def scenario_fleet_kill_replica(workdir: str) -> dict:
     }
 
 
+def scenario_preemption_as_scale_down(workdir: str) -> dict:
+    """Permanent replica death absorbed as an *observed scale-down*.
+
+    A 3-replica fleet with a zero restart budget loses rank 1 to SIGKILL
+    under mixed interactive+batch load. Nothing restarts it — instead
+    the ``FleetAutoscaler`` riding the router's scrape loop must reap
+    the corpse (sidecars scrubbed, discovery drops the rank, the router
+    purges its penalty-box/affinity state), log an
+    ``observed_scale_down`` decision carrying its inputs, and converge
+    on the new 2-replica target. Invariant chain: exactly the victim's
+    in-flight is lost (zero losses on survivors, total bounded by client
+    concurrency), the router ledger conserves every submitted request,
+    and the interactive tier is never starved while the fleet absorbs
+    the loss (zero fleet-unavailable outcomes, completions keep
+    flowing)."""
+    import threading
+
+    import fleet_bench
+
+    from machine_learning_apache_spark_tpu.fleet import (
+        AutoscaleConfig,
+        FleetAutoscaler,
+        FleetRouter,
+    )
+    from machine_learning_apache_spark_tpu.launcher import ReplicaGang
+
+    t0 = time.monotonic()
+    clients_per_tier = 3
+    translator, texts = fleet_bench.build_translator(tiny=True)
+    knobs = fleet_bench.bench_knobs(tiny=True)
+    fleet_dir = os.path.join(workdir, "fleet")
+    gang = ReplicaGang(
+        "fleet_bench:replica_main",
+        True,  # tiny
+        knobs,
+        num_replicas=3,
+        workdir=fleet_dir,
+        platform="cpu",
+        telemetry_http=None,
+        max_restarts_per_rank=0,  # first death is permanent — preemption
+        env={"MLSPARK_TELEMETRY_HTTP": ""},
+    ).start()
+    router = FleetRouter(
+        fleet_dir, policy="least_loaded", scrape_interval=0.25,
+    ).start()
+    # Thresholds parked out of reach: the only decision this drill wants
+    # is the observed scale-down, not a load-driven resize.
+    scaler = FleetAutoscaler(
+        gang,
+        config=AutoscaleConfig(
+            min_replicas=2, max_replicas=3,
+            burn_up=10.0, burn_down=0.0,
+            queue_up=1000.0, queue_down=0.0,
+            hysteresis_ticks=1000, cooldown_s=1.0,
+            drain_deadline_s=15.0, drain_batch_shed=0.5,
+        ),
+        admission=router.admission,
+    ).attach(router._scrape)
+    try:
+        if not router.wait_for_replicas(3, timeout=240.0):
+            raise RuntimeError(f"fleet never came healthy: {gang.status()}")
+        loads = {"interactive": {}, "batch": {}}
+
+        def drive(tier: str) -> None:
+            loads[tier].update(fleet_bench.drive_load(
+                router, texts, clients=clients_per_tier, duration=10.0,
+                tier=tier,
+            ))
+
+        loaders = [
+            threading.Thread(target=drive, args=(tier,), daemon=True)
+            for tier in loads
+        ]
+        for t in loaders:
+            t.start()
+        time.sleep(2.0)
+        killed = gang.kill_rank(1)
+
+        # Convergence: supervisor marks the rank exhausted, the scaler
+        # reaps it, discovery drops it, and the fleet settles at 2 live.
+        deadline = time.monotonic() + 60.0
+        converged = False
+        while time.monotonic() < deadline:
+            snaps = router._snapshot_source()
+            if (
+                scaler.observed_scale_downs >= 1
+                and len(gang.live_ranks()) == 2
+                and 1 not in snaps
+            ):
+                converged = True
+                break
+            time.sleep(0.25)
+        for t in loaders:
+            t.join(120.0)
+        wait_deadline = time.monotonic() + 60.0
+        while (router.ledger()["in_flight"] != 0
+               and time.monotonic() < wait_deadline):
+            time.sleep(0.2)
+        conservation = fleet_bench.conservation_gate(router)
+        per_replica = router.stats()["per_replica"]
+        decision = next(
+            (d for d in scaler.decisions
+             if d["action"] == "observed_scale_down"), None
+        )
+        scaler_stats = scaler.stats()
+        gang_status = gang.status()
+        router_stats = router.stats()
+    finally:
+        router.stop()
+        gang.stop()
+    lost_on_survivors = sum(
+        per_replica.get(r, {}).get("lost", 0)
+        + per_replica.get(r, {}).get("failed", 0)
+        for r in (0, 2)
+    )
+    lost_total = sum(load.get("failed", 0) for load in loads.values())
+    interactive = loads["interactive"]
+    decision_has_inputs = decision is not None and all(
+        k in decision
+        for k in ("action", "burn", "queue_depth", "live", "target")
+    )
+    return {
+        "scenario": "preemption_as_scale_down",
+        "clients_per_tier": clients_per_tier,
+        "kill_acknowledged": killed,
+        "converged_to_new_target": converged,
+        "loads": loads,
+        "lost_total": lost_total,
+        "lost_on_survivors": lost_on_survivors,
+        "decision": decision,
+        "scaler": scaler_stats,
+        "conservation": conservation,
+        "per_replica": per_replica,
+        "gang": gang_status,
+        "router_retries": router_stats["retries"],
+        "wall_seconds": round(time.monotonic() - t0, 2),
+        "ok": (
+            killed
+            and converged
+            and gang_status["exhausted"] == [1]
+            and gang_status["retired"] == [1]
+            and scaler_stats["observed_scale_downs"] == 1
+            and decision_has_inputs
+            and decision["target"] == 2
+            # Exactly the victim's in-flight is lost: survivors lose
+            # nothing, the total is bounded by client concurrency.
+            and lost_on_survivors == 0
+            and lost_total <= 2 * clients_per_tier
+            # Interactive tier never starved while the loss was absorbed.
+            and interactive.get("unavailable", 0) == 0
+            and interactive.get("completed", 0) > 0
+            and conservation["ok"]
+            and conservation["router_ledger"]["in_flight"] == 0
+        ),
+    }
+
+
 def scenario_elastic_shrink(workdir: str) -> dict:
     """Shrink-to-fit resume: 8 ranks -> kill 2 permanently -> finish on 6.
 
@@ -469,12 +632,13 @@ SCENARIOS = {
     "gang_stall": scenario_gang_stall,
     "serving_poison": scenario_serving_poison,
     "fleet_kill_replica": scenario_fleet_kill_replica,
+    "preemption_as_scale_down": scenario_preemption_as_scale_down,
 }
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
-    ap.add_argument("--out", default="FAULTS_r04.json")
+    ap.add_argument("--out", default="FAULTS_r05.json")
     ap.add_argument(
         "scenarios", nargs="*", default=None,
         help=f"subset to run (default: all of {sorted(SCENARIOS)})",
@@ -494,7 +658,7 @@ def main() -> int:
 
     report = {
         "artifact": "FAULTS",
-        "round": 4,
+        "round": 5,
         "all_ok": all(r["ok"] for r in results),
         "scenarios": results,
     }

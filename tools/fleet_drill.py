@@ -1,0 +1,556 @@
+"""Fleet autoscaling drill — a bursty open-loop replay against the closed loop.
+
+Stands up a real replica gang + router (the ``fleet_bench`` scaffolding)
+with a :class:`fleet.FleetAutoscaler` attached to the router's scrape
+loop, then drives an **open-loop** arrival process through a load step —
+baseline rate, a 4× burst, back to baseline — and measures what the
+control loop actually did:
+
+- **time-to-scale** — burst start → first ``scale_up`` decision, and
+  burst start → full target membership live in the gang;
+- **burn-rate recovery** — the router's per-tier SLO burn EWMA rises
+  while the burst outruns the fleet and must decay back once capacity
+  catches up;
+- **conservation** — zero lost non-in-flight requests: after the drain
+  the router ledger balances exactly (scale-downs retire replicas by
+  *draining* them, so their accepted work completes and their refusals
+  are retried elsewhere — nothing vanishes);
+- **decision log** — every scale decision is a ``fleet.autoscaler``
+  annotation carrying its inputs (burn, queue depth, live count,
+  target); the artifact embeds the full log.
+
+Single-core caveat (same as ``fleet_bench``): on one core the drill
+measures the *control loop* — trigger latency, drain correctness,
+conservation — not throughput scaling, since N CPU-bound replicas
+time-share the core. For the same reason the scale gates assert on
+gang *membership* (the control loop actuated: rank spawned, live,
+supervised), not on how fast a freshly spawned replica finishes its
+JIT warm-up under contention — warm-up latency is reported in the
+timeline, and the smoke separately gates that the replacement rank
+eventually scrapes healthy. The host-load preflight is stamped into
+the artifact either way.
+
+``--smoke`` is the tier-1 CI entry: a 2→3→2 cycle on the tiny model
+(closed-loop load trips the queue-depth trigger; load removal trips the
+scale-down), exiting nonzero if any gate fails. The full run writes
+``BENCH_SERVE_r07.json`` (``--out`` relocates).
+
+Usage: JAX_PLATFORMS=cpu python tools/fleet_drill.py [--smoke] [--out P]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fleet_bench import (  # noqa: E402
+    bench_knobs,
+    build_translator,
+    conservation_gate,
+    drive_load,
+    make_key_fn,
+)
+
+from machine_learning_apache_spark_tpu.utils.sysinfo import host_load  # noqa: E402
+
+#: Required keys on every decision record — the "annotation carries its
+#: inputs" acceptance gate, checked mechanically.
+DECISION_INPUT_KEYS = ("action", "burn", "queue_depth", "live", "target")
+
+
+def build_scaled_fleet(
+    n: int,
+    workdir: str,
+    *,
+    config,
+    knobs: dict | None = None,
+    key_fn=None,
+    wait_timeout: float = 240.0,
+):
+    """Gang + router + autoscaler riding the router's scrape loop.
+    Returns ``(gang, router, scaler)``; caller tears down in reverse."""
+    from machine_learning_apache_spark_tpu.fleet import (
+        FleetAutoscaler,
+        FleetRouter,
+    )
+    from machine_learning_apache_spark_tpu.launcher import ReplicaGang
+
+    gang = ReplicaGang(
+        "fleet_bench:replica_main",
+        True,  # tiny
+        knobs or bench_knobs(tiny=True),
+        num_replicas=n,
+        workdir=workdir,
+        platform="cpu",
+        telemetry_http=None,
+        env={"MLSPARK_TELEMETRY_HTTP": ""},
+    ).start()
+    router = FleetRouter(
+        workdir, policy="least_loaded", key_fn=key_fn,
+        scrape_interval=0.25,
+    ).start()
+    scaler = FleetAutoscaler(
+        gang, config=config, admission=router.admission,
+    ).attach(router._scrape)
+    if not router.wait_for_replicas(n, timeout=wait_timeout):
+        router.stop()
+        gang.stop()
+        raise RuntimeError(
+            f"fleet of {n} never came healthy in {workdir} "
+            f"(gang status: {gang.status()})"
+        )
+    return gang, router, scaler
+
+
+class OpenLoopDriver:
+    """Open-loop arrivals at a settable rate: requests fire on the clock
+    whether or not earlier ones finished (the load shape that actually
+    builds queues). Outstanding work is bounded; arrivals past the bound
+    are counted ``driver_shed`` — shed by the *client*, never submitted,
+    so they are deliberately outside the router's ledger."""
+
+    def __init__(
+        self,
+        router,
+        texts,
+        *,
+        deadline_s: float = 60.0,
+        batch_every: int = 4,
+        max_outstanding: int = 96,
+    ):
+        self.router = router
+        self.texts = texts
+        self.deadline_s = deadline_s
+        self.batch_every = batch_every
+        self._sem = threading.Semaphore(max_outstanding)
+        self._rate = 0.0
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.counts = {
+            "submitted": 0, "completed": 0, "rejected": 0,
+            "unavailable": 0, "failed": 0, "driver_shed": 0,
+        }
+        self._threads: list[threading.Thread] = []
+        self._pacer: threading.Thread | None = None
+        self._n = 0
+
+    def start(self) -> "OpenLoopDriver":
+        self._pacer = threading.Thread(
+            target=self._pace, name="drill-pacer", daemon=True
+        )
+        self._pacer.start()
+        return self
+
+    def set_rate(self, rate_hz: float) -> None:
+        with self._lock:
+            self._rate = max(0.0, float(rate_hz))
+
+    def stop(self, timeout: float = 120.0) -> dict:
+        self._stop.set()
+        if self._pacer is not None:
+            self._pacer.join(10.0)
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(max(0.05, deadline - time.monotonic()))
+        with self._lock:
+            return dict(self.counts)
+
+    def _pace(self) -> None:
+        # Token bucket at 10ms granularity: ``time.sleep(1/rate)`` per
+        # arrival can't sustain the calibrated rates a fast tiny model
+        # needs (hundreds of Hz) against OS sleep granularity.
+        credit = 0.0
+        last = time.monotonic()
+        while not self._stop.is_set():
+            time.sleep(0.01)
+            now = time.monotonic()
+            with self._lock:
+                rate = self._rate
+            if rate <= 0:
+                credit = 0.0
+                last = now
+                continue
+            credit = min(credit + (now - last) * rate, max(1.0, rate))
+            last = now
+            while credit >= 1.0:
+                credit -= 1.0
+                if self._sem.acquire(blocking=False):
+                    n = self._n
+                    self._n += 1
+                    t = threading.Thread(
+                        target=self._one, args=(n,), daemon=True
+                    )
+                    t.start()
+                    self._threads.append(t)
+                else:
+                    with self._lock:
+                        self.counts["driver_shed"] += 1
+            if len(self._threads) > 512:
+                self._threads = [t for t in self._threads if t.is_alive()]
+
+    def _one(self, n: int) -> None:
+        from machine_learning_apache_spark_tpu.fleet import (
+            FleetBackpressure,
+            FleetRequestFailed,
+            FleetUnavailable,
+        )
+
+        tier = "batch" if n % self.batch_every == 0 else "interactive"
+        outcome = "failed"
+        try:
+            with self._lock:
+                self.counts["submitted"] += 1
+            try:
+                self.router.submit(
+                    self.texts[n % len(self.texts)],
+                    tier=tier, deadline_s=self.deadline_s,
+                )
+                outcome = "completed"
+            except FleetBackpressure:
+                outcome = "rejected"
+            except FleetUnavailable:
+                outcome = "unavailable"
+            except FleetRequestFailed:
+                outcome = "failed"
+            with self._lock:
+                self.counts[outcome] += 1
+        finally:
+            self._sem.release()
+
+
+def _burn_ewma(router, tier: str = "interactive") -> float:
+    slo = router.stats().get("slo") or {}
+    return float((slo.get(tier) or {}).get("ewma") or 0.0)
+
+
+def _healthy_count(router) -> int:
+    return len([
+        s for s in router._snapshot_source().values()
+        if s.healthy and not s.draining
+    ])
+
+
+def _wait(pred, timeout: float, poll: float = 0.5) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def _sampler(router, gang, scaler, samples: list, stop: threading.Event,
+             t0: float, interval: float = 0.5) -> None:
+    while not stop.is_set():
+        samples.append({
+            "t": round(time.monotonic() - t0, 2),
+            "healthy": _healthy_count(router),
+            "live": len(gang.live_ranks()),
+            "burn_interactive": round(_burn_ewma(router), 6),
+            "ledger_in_flight": router.ledger()["in_flight"],
+        })
+        stop.wait(interval)
+
+
+def _decision_gate(decisions: list[dict]) -> dict:
+    """Every decision must carry its inputs — the acceptance criterion
+    made mechanical."""
+    missing = [
+        d.get("action", "?") for d in decisions
+        if any(k not in d for k in DECISION_INPUT_KEYS)
+    ]
+    return {
+        "decisions": len(decisions),
+        "missing_inputs": missing[:8],
+        "ok": bool(decisions) and not missing,
+    }
+
+
+def run_full(out_path: str, *, burst_s: float, settle_s: float) -> int:
+    import tempfile
+
+    from machine_learning_apache_spark_tpu.fleet import AutoscaleConfig
+
+    host = host_load()  # preflight — before any replica spawns
+    translator, texts = build_translator(tiny=True)
+    knobs = bench_knobs(tiny=True)
+    workdir = tempfile.mkdtemp(prefix="mlspark_fleet_drill_")
+    config = AutoscaleConfig(
+        min_replicas=1, max_replicas=4,
+        burn_up=0.1, burn_down=0.05,
+        queue_up=3.0, queue_down=1.0,
+        hysteresis_ticks=2, cooldown_s=3.0,
+        drain_deadline_s=20.0, drain_batch_shed=0.5,
+    )
+    gang, router, scaler = build_scaled_fleet(
+        1, workdir, config=config, knobs=knobs,
+        key_fn=make_key_fn(translator),
+    )
+    samples: list[dict] = []
+    sample_stop = threading.Event()
+    t0 = time.monotonic()
+    threading.Thread(
+        target=_sampler, args=(router, gang, scaler, samples, sample_stop, t0),
+        daemon=True,
+    ).start()
+    # A 1s deadline is generous at baseline (~tens of ms end to end) but
+    # burns once the burst's queue delay exceeds it — giving the burn
+    # gauge something to recover *from* in the artifact.
+    driver = OpenLoopDriver(router, texts, deadline_s=1.0).start()
+    try:
+        # Phase 0 — calibrate the step to THIS host: a short closed-loop
+        # probe measures single-replica capacity, the baseline sits at
+        # half of it, and the 4x burst lands at 2x capacity — so the
+        # queue must build no matter how fast the tiny model happens to
+        # serve here (a fixed few-Hz burst is invisible to a model with
+        # a ~25ms p50).
+        probe = drive_load(router, texts, clients=4, duration=5.0)
+        cap_hz = max(2.0, float(probe.get("requests_per_sec") or 0.0))
+        base_rate = 0.5 * cap_hz
+        print(json.dumps({
+            "phase": "calibrate", "capacity_hz": round(cap_hz, 1),
+            "base_rate_hz": round(base_rate, 1),
+        }), flush=True)
+        # Phase 1 — baseline: the 1-replica fleet keeps up.
+        driver.set_rate(base_rate)
+        time.sleep(5.0)
+        # Phase 2 — 4x burst: queues build, burn rises, the loop reacts.
+        t_burst = time.monotonic()
+        wall_burst = time.time()
+        driver.set_rate(4.0 * base_rate)
+        print(json.dumps({"phase": "burst", "rate_hz": 4.0 * base_rate}),
+              flush=True)
+        scaled_4x = _wait(
+            lambda: len(gang.live_ranks()) >= config.max_replicas,
+            timeout=burst_s,
+        )
+        burn_peak = _burn_ewma(router)
+        t_peak = time.monotonic() - t_burst
+        first_up = next(
+            (d for d in scaler.decisions
+             if d["action"] == "scale_up" and d.get("wall", 0) >= wall_burst),
+            None,
+        )
+        print(json.dumps({
+            "phase": "burst_done", "scaled_4x": scaled_4x,
+            "healthy": _healthy_count(router),
+            "burn_peak": round(burn_peak, 6),
+        }), flush=True)
+        # Phase 3 — step back down: the fleet must give capacity back.
+        driver.set_rate(0.25 * base_rate)
+        scaled_back = _wait(
+            lambda: len(gang.live_ranks()) <= config.min_replicas,
+            timeout=settle_s,
+        )
+        driver.set_rate(0.0)
+        load = driver.stop()
+        # Let in-flight drain before judging the ledger.
+        _wait(lambda: router.ledger()["in_flight"] == 0, timeout=90.0)
+        burn_final = _burn_ewma(router)
+        conservation = conservation_gate(router)
+        scaler_stats = scaler.stats()
+        router_stats = router.stats()
+        decisions = list(scaler.decisions)
+    finally:
+        sample_stop.set()
+        driver.stop(timeout=5.0)
+        router.stop()
+        gang.stop()
+    # The true burn peak lives in the 0.5s-sampled timeline, not at the
+    # instant the scale-up wait happened to return — the gauge spikes
+    # while the burst outruns the fleet and the sampler sees it.
+    burn_peak = max(
+        (s["burn_interactive"] for s in samples), default=burn_peak,
+    )
+    decision_gate = _decision_gate(decisions)
+    gates = {
+        "scaled_4x_up": scaled_4x,
+        "scaled_back_down": scaled_back,
+        "time_to_scale": first_up is not None,
+        # Recovery: once capacity caught up and the step ended, the burn
+        # EWMA must have decayed from its peak (or never burned at all).
+        "burn_recovered": (
+            burn_final <= config.burn_down
+            or burn_final <= 0.8 * burn_peak
+        ),
+        "zero_lost_non_in_flight": conservation["ok"],
+        "decisions_carry_inputs": decision_gate["ok"],
+    }
+    ok = all(gates.values())
+    artifact = {
+        "bench": "fleet_autoscale",
+        "round": 7,
+        "smoke": False,
+        "host_load": host,
+        "contended": host["contended"],
+        "single_core_caveat": (
+            "control-loop drill: on a 1-core host the replicas time-share "
+            "the CPU, so this measures trigger latency, drain correctness "
+            "and conservation — not throughput scaling"
+            if (host.get("cores") or 1) < 2 else None
+        ),
+        "config": scaler_stats["config"],
+        "burst": {
+            "capacity_probe": probe,
+            "base_rate_hz": round(base_rate, 2),
+            "burst_rate_hz": round(4.0 * base_rate, 2),
+            "time_to_first_scale_up_s": (
+                round(first_up["wall"] - wall_burst, 2) if first_up else None
+            ),
+            "time_to_max_live_s": round(t_peak, 2),
+            "burn_peak": round(burn_peak, 6),
+            "burn_final": round(burn_final, 6),
+        },
+        "load": load,
+        "timeline": samples,
+        "decisions": decisions,
+        "decision_gate": decision_gate,
+        "scaler": scaler_stats,
+        "conservation": conservation,
+        "router": router_stats,
+        "gates": gates,
+        "ok": ok,
+    }
+    with open(out_path, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+    print(json.dumps({"wrote": out_path, "gates": gates, "ok": ok}),
+          flush=True)
+    return 0 if ok else 1
+
+
+def run_smoke(out_path: str | None) -> int:
+    """Tier-1 entry: 2→3→2 on the tiny model. Closed-loop clients trip
+    the queue-depth trigger (deterministic on a loaded CI host where a
+    burn trigger would be noisy); removing the load trips the drain."""
+    import tempfile
+
+    from machine_learning_apache_spark_tpu.fleet import AutoscaleConfig
+
+    host = host_load()  # preflight — before any replica spawns
+    translator, texts = build_translator(tiny=True)
+    knobs = bench_knobs(tiny=True)
+    workdir = tempfile.mkdtemp(prefix="mlspark_fleet_drill_smoke_")
+    config = AutoscaleConfig(
+        min_replicas=2, max_replicas=3,
+        burn_up=0.5, burn_down=0.05,
+        queue_up=1.5, queue_down=0.5,
+        hysteresis_ticks=2, cooldown_s=2.0,
+        drain_deadline_s=15.0, drain_batch_shed=0.5,
+    )
+    gang, router, scaler = build_scaled_fleet(
+        2, workdir, config=config, knobs=knobs,
+        key_fn=make_key_fn(translator),
+    )
+    try:
+        load_result: dict = {}
+
+        def _load() -> None:
+            load_result.update(drive_load(
+                router, texts, clients=8, duration=40.0,
+            ))
+
+        load_thread = threading.Thread(target=_load, daemon=True)
+        load_thread.start()
+        # Membership gate: the control law fired and actuated (third
+        # rank spawned and live). On a contended 1-core CI host the new
+        # replica's JIT warm-up can outlast the whole load step, so
+        # "scrapes healthy" is gated separately below, after the load.
+        scaled_up = _wait(
+            lambda: scaler.scale_ups >= 1 and len(gang.live_ranks()) >= 3,
+            timeout=150.0,
+        )
+        print(json.dumps({
+            "scaled_up": scaled_up, "live": len(gang.live_ranks()),
+            "healthy": _healthy_count(router),
+        }), flush=True)
+        load_thread.join(180.0)
+        scaled_down = _wait(
+            lambda: (
+                scaler.scale_downs >= 1
+                and len(gang.live_ranks()) == config.min_replicas
+            ),
+            timeout=240.0,
+        )
+        print(json.dumps({
+            "scaled_down": scaled_down, "live": len(gang.live_ranks()),
+        }), flush=True)
+        # The drain picks a *healthy* victim, so the surviving pair is
+        # old-rank + replacement — the cycle only counts if the added
+        # rank actually becomes a serving replica.
+        replacement_serves = _wait(
+            lambda: _healthy_count(router) >= config.min_replicas,
+            timeout=240.0,
+        )
+        print(json.dumps({
+            "replacement_serves": replacement_serves,
+            "healthy": _healthy_count(router),
+        }), flush=True)
+        _wait(lambda: router.ledger()["in_flight"] == 0, timeout=60.0)
+        conservation = conservation_gate(router)
+        scaler_stats = scaler.stats()
+        decisions = list(scaler.decisions)
+        gang_status = gang.status()
+    finally:
+        router.stop()
+        gang.stop()
+    decision_gate = _decision_gate(decisions)
+    gates = {
+        "scaled_up_2_to_3": scaled_up,
+        "scaled_down_3_to_2": scaled_down,
+        "replacement_rank_serves": replacement_serves,
+        "zero_lost_non_in_flight": conservation["ok"],
+        "decisions_carry_inputs": decision_gate["ok"],
+    }
+    ok = all(gates.values())
+    artifact = {
+        "bench": "fleet_autoscale",
+        "smoke": True,
+        "host_load": host,
+        "contended": host["contended"],
+        "config": scaler_stats["config"],
+        "load": load_result,
+        "decisions": decisions,
+        "decision_gate": decision_gate,
+        "scaler": scaler_stats,
+        "conservation": conservation,
+        "gang": gang_status,
+        "gates": gates,
+        "ok": ok,
+    }
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(artifact, fh, indent=1)
+    print(json.dumps({"gates": gates, "ok": ok}), flush=True)
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 self-test: 2→3→2 autoscale cycle")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (full run defaults to "
+                         "BENCH_SERVE_r07.json; smoke writes one only "
+                         "when --out is given)")
+    ap.add_argument("--burst", type=float, default=180.0,
+                    help="max seconds to wait for the 4x scale-up")
+    ap.add_argument("--settle", type=float, default=240.0,
+                    help="max seconds to wait for the scale-back-down")
+    ns = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("MLSPARK_TELEMETRY_HTTP", "")
+    if ns.smoke:
+        return run_smoke(ns.out)
+    return run_full(
+        ns.out or "BENCH_SERVE_r07.json",
+        burst_s=ns.burst, settle_s=ns.settle,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
