@@ -239,6 +239,13 @@ class FleetAdmission:
         return max(0.01, self._service_ewma * over)
 
     # -- introspection -------------------------------------------------------
+    def service_ewma(self) -> float:
+        """The raw observed-service-time EWMA (seconds) — the router's
+        hedge-delay basis: a hedge fires only once a dispatch has been
+        outstanding noticeably longer than a typical request takes."""
+        with self._lock:
+            return self._service_ewma
+
     def stats(self) -> dict:
         with self._lock:
             return {

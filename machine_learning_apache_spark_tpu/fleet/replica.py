@@ -14,6 +14,20 @@ contract onto HTTP status codes the router can dispatch around —
 - **504** — the request's deadline expired inside this replica.
 - **500** — the decode step itself failed (``InternalError``).
 
+``POST /v1/cancel`` is the hedging router's remote reap: keyed by the
+router-minted trace id (the one the traceparent header carried in and
+the engine's ``RequestTrace`` adopted), it force-expires the matching
+in-flight request — still-queued work dies in the next queue sweep,
+mid-decode work at the engine's next between-launch deadline sweep,
+freeing its KV pages and launch slot. The abandoned handler thread then
+answers 504 to a caller that already took the winning response.
+
+The handler is also the application point for the ``wire`` fault family
+(``utils.faults.wire_fault``): delay / black-hole / torn-response /
+corrupt-body / slow-drip, matched by deterministic (rank,
+request-ordinal) coordinates — the router's retry taxonomy drilled at
+the exact layer it claims to handle.
+
 The same server answers the observability plane's GET endpoints
 (``/healthz``, ``/statusz``, ``/metrics``, ``/flightz``) by delegating
 to ``telemetry.http``'s payload functions, so the router's scrape loop
@@ -46,6 +60,7 @@ from machine_learning_apache_spark_tpu.telemetry import (
     tracectx as _tracectx,
 )
 from machine_learning_apache_spark_tpu.utils import env as envcfg
+from machine_learning_apache_spark_tpu.utils import faults as _faults
 from machine_learning_apache_spark_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -112,10 +127,39 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
 
     # -- data plane ----------------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802 — http.server API
+        owner: ReplicaServer = self.server.replica  # type: ignore[attr-defined]
+        if self.path == "/v1/cancel":
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length).decode("utf-8"))
+                trace_id = body["trace_id"]
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply(400, {"error": f"bad request body: {e!r}"})
+                return
+            code, payload = owner.cancel(trace_id)
+            self._reply(code, payload)
+            return
         if self.path != "/v1/generate":
             self._reply(404, {"error": f"no endpoint {self.path!r}"})
             return
-        owner: ReplicaServer = self.server.replica  # type: ignore[attr-defined]
+        # Wire fault injection happens HERE, at the socket, before the
+        # engine sees anything: the ordinal is this server's zero-based
+        # exchange count, so a drill pins a fault to exactly one exchange
+        # on exactly one rank.
+        ordinal = owner.next_wire_ordinal()
+        spec = _faults.wire_fault(rank=owner.rank, req=ordinal)
+        if spec is not None:
+            owner.note_wire_fault(spec, ordinal)
+            if spec.action == "delay" and spec.ms:
+                time.sleep(spec.ms / 1000.0)
+            elif spec.action == "blackhole":
+                # Swallow the exchange: drain the request so the client
+                # isn't stuck writing, answer nothing, hang up. The
+                # router classifies this "lost" — terminal, no replay.
+                length = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(length)
+                self.close_connection = True
+                return
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length).decode("utf-8"))
@@ -133,6 +177,9 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         headers = {}
         if code == 429 and payload.get("retry_after") is not None:
             headers["Retry-After"] = f"{payload['retry_after']:.3f}"
+        if spec is not None and spec.action in ("torn", "corrupt", "drip"):
+            self._reply_wire(spec, code, payload, headers)
+            return
         self._reply(code, payload, headers)
 
     # -- observability plane (delegated) -------------------------------------
@@ -197,6 +244,42 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass  # client hung up — its in-flight request, its loss
 
+    def _reply_wire(
+        self, spec, code: int, payload: dict, headers: dict | None = None
+    ) -> None:
+        """Deliver a real response through an injected wire fault —
+        the response-side half of the ``wire`` family."""
+        data = (json.dumps(payload) + "\n").encode("utf-8")
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if spec.action == "torn":
+                # Full Content-Length, half a body, then hang up: the
+                # client sees a short read — indistinguishable from a
+                # replica dying mid-response ("lost", terminal).
+                self.wfile.write(data[: max(1, len(data) // 2)])
+                self.wfile.flush()
+                self.close_connection = True
+            elif spec.action == "corrupt":
+                # Right length, unparseable content: the router's JSON
+                # decode fails — also "lost", also terminal.
+                self.wfile.write(b"#" * (len(data) - 1) + b"\n")
+            elif spec.action == "drip":
+                # Trickle the body out over ~spec.ms total — the slow
+                # response a hedge should beat without any hard failure.
+                chunks = [data[i:i + 16] for i in range(0, len(data), 16)]
+                pause = (spec.ms / 1000.0) / max(1, len(chunks))
+                for chunk in chunks:
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                    time.sleep(pause)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up — its in-flight request, its loss
+
 
 class ReplicaServer:
     """The HTTP front door over one started ``ServingEngine``."""
@@ -231,10 +314,61 @@ class ReplicaServer:
         self.rejected = 0
         self.refused_503 = 0
         self.failed = 0
+        self.expired = 0
+        self.cancelled = 0
+        self.wire_faults = 0
+        self._wire_ordinal = 0
+        # trace_id -> in-flight ServeRequest: the /v1/cancel key space.
+        # Entries live exactly as long as a handler thread waits on the
+        # engine future — insert after submit, pop in its finally.
+        self._inflight: dict[str, object] = {}
 
     @property
     def draining(self) -> bool:
         return self._draining
+
+    def next_wire_ordinal(self) -> int:
+        """Zero-based ordinal of the next ``/v1/generate`` exchange —
+        the ``req`` coordinate wire fault specs match against."""
+        with self._lock:
+            n = self._wire_ordinal
+            self._wire_ordinal += 1
+            return n
+
+    def note_wire_fault(self, spec, ordinal: int) -> None:
+        with self._lock:
+            self.wire_faults += 1
+        _events.annotate(
+            "fleet.wire_fault", rank=self.rank, action=spec.action,
+            req=ordinal, key=spec.key,
+        )
+
+    def cancel(self, trace_id: str) -> tuple[int, dict]:
+        """Remote reap (the hedging router's loser-cancellation path):
+        force-expire the in-flight request carrying this router-minted
+        trace id by pulling its deadline to *now*. Still-queued work dies
+        in the immediate queue sweep; mid-decode work at the engine's
+        next between-launch deadline sweep — either way its pages and
+        slot free, the engine ledger books ``expired``, and the waiting
+        handler thread answers 504 to a caller that no longer cares."""
+        with self._lock:
+            req = self._inflight.get(trace_id)
+        if req is None:
+            return 404, {
+                "cancelled": False,
+                "rank": self.rank,
+                "error": "no in-flight request with that trace id",
+            }
+        req.deadline = self.engine.clock()
+        with self._lock:
+            self.cancelled += 1
+        self.engine.queue.expire_now()
+        _events.annotate(
+            "fleet.replica_cancel", rank=self.rank, trace_id=trace_id
+        )
+        return 200, {
+            "cancelled": True, "rank": self.rank, "trace_id": trace_id,
+        }
 
     def set_draining(self, flag: bool = True) -> None:
         """Flip the front door to refuse-new-work mode: ``/healthz``
@@ -358,19 +492,27 @@ class ReplicaServer:
             with self._lock:
                 self.refused_503 += 1
             return 503, {"error": repr(e), "rank": self.rank}
+        trace_id = req.trace.trace_id
+        with self._lock:
+            self._inflight[trace_id] = req
         timeout = (deadline_s or 120.0) + RESULT_GRACE_S
         try:
             out = req.result(timeout=timeout)
         except DeadlineExceeded as e:
+            # Deadline burn-down or a remote /v1/cancel — either way the
+            # engine booked ``expired``; mirror that here, not ``failed``.
             with self._lock:
-                self.failed += 1
+                self.expired += 1
             return 504, {"error": str(e), "rank": self.rank,
-                         "trace_id": req.trace.trace_id}
+                         "trace_id": trace_id}
         except Exception as e:  # noqa: BLE001 — InternalError, stop, timeout
             with self._lock:
                 self.failed += 1
             return 500, {"error": repr(e), "rank": self.rank,
-                         "trace_id": req.trace.trace_id}
+                         "trace_id": trace_id}
+        finally:
+            with self._lock:
+                self._inflight.pop(trace_id, None)
         with self._lock:
             self.completed += 1
         return 200, {
@@ -398,6 +540,9 @@ class ReplicaServer:
                 "rejected": self.rejected,
                 "refused_503": self.refused_503,
                 "failed": self.failed,
+                "expired": self.expired,
+                "cancelled": self.cancelled,
+                "wire_faults": self.wire_faults,
             }
 
 

@@ -26,16 +26,32 @@ on refusals. The retry taxonomy is the whole fault story:
 - **connection lost mid-request / 5xx** — the request may have been
   decoding; it is *not* silently retried (that is the "only the killed
   replica's in-flight is lost" conservation story) and counts failed.
+- **504** — the deadline expired inside the replica; terminal as
+  ``expired`` (the engine already booked the same outcome).
+
+**Straggler hedging** (Dean & Barroso, "The Tail at Scale"; off by
+default, ``MLSPARK_FLEET_HEDGE``): when a dispatch on an eligible tier
+is still outstanding after the hedge delay (a multiple of the admission
+layer's service-time EWMA), the router issues ONE duplicate to a second
+healthy replica — never the same rank. First response wins; the loser
+is reaped through ``POST /v1/cancel``, keyed by the router-minted trace
+id both attempts shared. A hedge is only ever issued while the primary
+is still *in flight* — a terminal lost/5xx never spawns a new attempt
+(lost-is-lost holds), though an already-in-flight hedge may still save
+the request. ``hedged`` and ``cancelled`` are attempt-level side
+counters, deliberately outside the conservation law: a hedged request
+still lands in exactly one terminal bucket.
 
 Every terminal outcome lands in the router ledger, which obeys the same
 conservation law as the engine's: submitted == completed + rejected +
-unavailable + failed. ``check_conservation`` raises otherwise.
+unavailable + failed + expired. ``check_conservation`` raises otherwise.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import queue as _pyqueue
 import threading
 import time
 import urllib.error
@@ -52,6 +68,7 @@ from machine_learning_apache_spark_tpu.fleet.scrape import (
     fleet_slo_rollup,
 )
 from machine_learning_apache_spark_tpu.serving.metrics import BurnRate
+from machine_learning_apache_spark_tpu.serving.queue import DeadlineExceeded
 from machine_learning_apache_spark_tpu.telemetry import events as _events
 from machine_learning_apache_spark_tpu.telemetry import (
     registry as _registry,
@@ -142,7 +159,7 @@ class ReplicaClient:
         traceparent: str | None = None,
     ) -> tuple[str, int | None, dict]:
         """Returns ``(kind, http_status, payload)`` with kind in
-        {"ok", "refused", "backpressure", "failed", "lost"}.
+        {"ok", "refused", "backpressure", "failed", "lost", "expired"}.
         ``traceparent`` (when tracing is on and the request was sampled)
         rides as the W3C header so the replica joins the trace."""
         body = json.dumps({
@@ -174,7 +191,11 @@ class ReplicaClient:
                 return "backpressure", 429, payload
             if e.code == 503:
                 return "refused", 503, payload
-            # 400/500/504: the replica answered — the request itself is
+            if e.code == 504:
+                # The deadline expired inside the replica — the engine
+                # booked ``expired``; mirror the taxonomy, still terminal.
+                return "expired", 504, payload
+            # 400/500: the replica answered — the request itself is
             # terminal there; retrying would double-spend decode work.
             return "failed", e.code, payload
         except urllib.error.URLError as e:
@@ -184,6 +205,25 @@ class ReplicaClient:
             return "lost", None, {"error": repr(e)}
         except Exception as e:  # noqa: BLE001 — socket reset mid-read etc.
             return "lost", None, {"error": repr(e)}
+
+    @staticmethod
+    def cancel(port: int, trace_id: str, *, timeout: float = 5.0) -> bool:
+        """Best-effort loser reap after a hedge race: ``POST /v1/cancel``
+        keyed by the router-minted trace id. False on any failure — a
+        cancel that misses only wastes the loser's remaining decode."""
+        body = json.dumps({"trace_id": trace_id}).encode("utf-8")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/cancel",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                payload = json.loads(resp.read().decode("utf-8"))
+            return bool(payload.get("cancelled"))
+        except Exception:  # noqa: BLE001 — best-effort by contract
+            return False
 
 
 class FleetRouter:
@@ -208,6 +248,10 @@ class FleetRouter:
         scrape_interval: float | None = None,
         request_timeout_s: float = 120.0,
         clock=time.monotonic,
+        hedge: bool | None = None,
+        hedge_tiers=None,
+        hedge_delay_factor: float | None = None,
+        hedge_min_delay_s: float | None = None,
     ):
         from machine_learning_apache_spark_tpu.utils import env as envcfg
 
@@ -231,6 +275,28 @@ class FleetRouter:
         self.key_fn = key_fn
         self.clock = clock
         self.request_timeout_s = request_timeout_s
+        # Straggler hedging (arg > env > default; off by default so the
+        # plain dispatch path is byte-for-byte what it always was).
+        if hedge is None:
+            hedge = envcfg.get_bool("MLSPARK_FLEET_HEDGE")
+        if hedge_tiers is None:
+            hedge_tiers = envcfg.get_str("MLSPARK_FLEET_HEDGE_TIERS")
+        if isinstance(hedge_tiers, str):
+            hedge_tiers = tuple(
+                t.strip() for t in hedge_tiers.split(",") if t.strip()
+            )
+        if hedge_delay_factor is None:
+            hedge_delay_factor = envcfg.get_float(
+                "MLSPARK_FLEET_HEDGE_DELAY_FACTOR"
+            )
+        if hedge_min_delay_s is None:
+            hedge_min_delay_s = envcfg.get_float(
+                "MLSPARK_FLEET_HEDGE_MIN_DELAY_S"
+            )
+        self.hedge = bool(hedge)
+        self.hedge_tiers = tuple(hedge_tiers)
+        self.hedge_delay_factor = float(hedge_delay_factor)
+        self.hedge_min_delay_s = float(hedge_min_delay_s)
         self.admission = admission or FleetAdmission()
         self.affinity = affinity or AffinityTable()
         self._scrape: ScrapeLoop | None = None
@@ -256,7 +322,12 @@ class FleetRouter:
         self.rejected = 0      # fleet admission / all-replica backpressure
         self.unavailable = 0   # no healthy replica reachable
         self.failed = 0        # dispatched and lost / decode failure
+        self.expired = 0       # deadline burned down (locally or 504)
         self.retries = 0
+        # Attempt-level hedging counters, outside the conservation law:
+        # a hedged request still retires in exactly one terminal bucket.
+        self.hedged = 0        # duplicate dispatches issued
+        self.cancelled = 0     # loser reaps sent via /v1/cancel
         self._per_replica: dict[int, dict] = {}
         # Per-tier SLO burn gauges over *routed* outcomes: a request
         # "missed" unless it completed within its deadline — rejected,
@@ -267,7 +338,8 @@ class FleetRouter:
         self._counters = {
             name: self._reg.counter("fleet", name)
             for name in ("submitted", "completed", "rejected",
-                         "unavailable", "failed", "retries")
+                         "unavailable", "failed", "expired", "retries",
+                         "hedged", "cancelled")
         }
 
     # -- lifecycle -----------------------------------------------------------
@@ -342,7 +414,10 @@ class FleetRouter:
         payload. Raises :class:`FleetBackpressure` (whole fleet at
         capacity / quota exhausted), :class:`FleetUnavailable` (no
         healthy replica), :class:`FleetRequestFailed` (dispatched and
-        lost or decode-failed — the non-retried taxonomy).
+        lost or decode-failed — the non-retried taxonomy), or
+        :class:`~machine_learning_apache_spark_tpu.serving.queue.
+        DeadlineExceeded` (budget burned down before dispatch, or the
+        replica 504'd — outcome ``expired`` either way).
 
         Distributed tracing: the router is where a request's trace is
         **minted** (head-sampled once, here). The whole dispatch lives
@@ -373,6 +448,19 @@ class FleetRouter:
                 tried: set[int] = set()
                 backpressure: FleetBackpressure | None = None
                 while True:
+                    # Pre-dispatch deadline check: a request that burned
+                    # its whole budget cycling the retry/penalty-box loop
+                    # fails HERE as expired — dispatching with a negative
+                    # remaining budget would only make a replica decode
+                    # tokens nobody is still waiting for.
+                    remaining = deadline - (self.clock() - t0)
+                    if remaining <= 0:
+                        outcome = "expired"
+                        self._bump("expired")
+                        raise DeadlineExceeded(
+                            f"deadline of {deadline:.3f}s elapsed before "
+                            f"dispatch (retries={retries})"
+                        )
                     snaps = self._usable_snapshots()
                     rank = pick_replica(
                         snaps,
@@ -393,24 +481,16 @@ class FleetRouter:
                         )
                     tried.add(rank)
                     snap = snaps[rank]
-                    self._note(rank, "dispatched")
-                    # One child span id per attempt: the replica records
-                    # it as remote_parent, which is how the merged view
-                    # attaches each replica's spans to the right attempt.
-                    attempt = _tracectx.child(ctx)
-                    attempt_attrs = {"replica": rank}
-                    if attempt is not None:
-                        attempt_attrs["ctx_span"] = attempt.span_id
-                    with _spans.span("fleet.attempt", **attempt_attrs):
-                        kind, status, payload = ReplicaClient.generate(
-                            snap.port, text,
-                            deadline_s=deadline, tier=tier, tenant=tenant,
-                            timeout=min(self.request_timeout_s,
-                                        deadline + 30.0),
-                            traceparent=(
-                                None if attempt is None
-                                else _tracectx.to_traceparent(attempt)
-                            ),
+                    if self.hedge and tier in self.hedge_tiers:
+                        rank, kind, status, payload = self._dispatch_hedged(
+                            snaps, rank, snap, text, remaining=remaining,
+                            tier=tier, tenant=tenant, ctx=ctx,
+                            digest=digest, tried=tried,
+                        )
+                    else:
+                        rank, kind, status, payload = self._attempt(
+                            rank, snap.port, text, budget=remaining,
+                            tier=tier, tenant=tenant, ctx=ctx,
                         )
                     if kind == "ok":
                         self.affinity.note_routed(digest, rank)
@@ -439,6 +519,17 @@ class FleetRouter:
                         retries += 1
                         self._bump("retries")
                         continue
+                    if kind == "expired":
+                        # The replica's engine reaped the request at its
+                        # deadline (504): terminal, same outcome bucket
+                        # as the local pre-dispatch expiry.
+                        self._note(rank, "expired")
+                        outcome, out_rank = "expired", rank
+                        self._bump("expired")
+                        raise DeadlineExceeded(
+                            f"request expired on replica {rank}: "
+                            f"{(payload or {}).get('error')}"
+                        )
                     # "lost" or "failed": terminal, not retried.
                     self._note(rank, "lost" if kind == "lost" else "failed")
                     outcome, out_rank = kind, rank
@@ -464,6 +555,179 @@ class FleetRouter:
                     tenant=tenant, retries=retries, total_s=round(total, 6),
                     status=status,
                 )
+
+    # -- dispatch attempts ---------------------------------------------------
+    def _attempt(
+        self, rank: int, port: int, text: str, *, budget: float,
+        tier: str, tenant: str | None, ctx,
+    ) -> tuple[int, str, int | None, dict]:
+        """One wire dispatch under its own ``fleet.attempt`` span.
+        ``budget`` is the request's *remaining* deadline — what the
+        replica gets as ``deadline_s``, so a late retry or a hedge is
+        granted only the time actually left. Runs on the submit thread
+        (plain path) or a hedge worker thread (the ``use(ctx)`` wrap is
+        what keeps the worker's events on the request's trace)."""
+        self._note(rank, "dispatched")
+        # One child span id per attempt: the replica records it as
+        # remote_parent, which is how the merged view attaches each
+        # replica's spans to the right attempt.
+        attempt = _tracectx.child(ctx)
+        attempt_attrs = {"replica": rank}
+        if attempt is not None:
+            attempt_attrs["ctx_span"] = attempt.span_id
+        with _tracectx.use(ctx), _spans.span("fleet.attempt",
+                                             **attempt_attrs):
+            kind, status, payload = ReplicaClient.generate(
+                port, text,
+                deadline_s=budget, tier=tier, tenant=tenant,
+                timeout=min(self.request_timeout_s, budget + 30.0),
+                traceparent=(
+                    None if attempt is None
+                    else _tracectx.to_traceparent(attempt)
+                ),
+            )
+        return rank, kind, status, payload
+
+    def _dispatch_hedged(
+        self, snaps, rank: int, snap, text: str, *, remaining: float,
+        tier: str, tenant: str | None, ctx, digest, tried: set[int],
+    ) -> tuple[int, str, int | None, dict]:
+        """One dispatch round with straggler hedging: launch the primary,
+        and if it is still outstanding after the hedge delay, launch ONE
+        duplicate on a different healthy rank. First ``ok`` wins and the
+        loser is reaped via ``/v1/cancel``; with no winner the two
+        outcomes reduce to a single result for the caller's taxonomy
+        (terminal > backpressure > refused — a terminal sibling must
+        dominate, or the retry loop would replay half-done work)."""
+        t_call = self.clock()
+        results: _pyqueue.Queue = _pyqueue.Queue()
+        outstanding: dict[int, int] = {}  # rank -> port
+
+        def run(a_rank: int, a_port: int, budget: float) -> None:
+            try:
+                results.put(self._attempt(
+                    a_rank, a_port, text, budget=budget,
+                    tier=tier, tenant=tenant, ctx=ctx,
+                ))
+            except Exception as e:  # noqa: BLE001 — an attempt must report
+                results.put((a_rank, "lost", None, {"error": repr(e)}))
+
+        def spawn(a_rank: int, a_port: int, budget: float) -> None:
+            outstanding[a_rank] = a_port
+            threading.Thread(
+                target=run, args=(a_rank, a_port, budget),
+                name=f"fleet-hedge-{a_rank}", daemon=True,
+            ).start()
+
+        spawn(rank, snap.port, remaining)
+        delay = max(
+            self.hedge_min_delay_s,
+            self.hedge_delay_factor * self.admission.service_ewma(),
+        )
+        try:
+            res = results.get(timeout=min(delay, max(remaining, 0.01)))
+            # Primary answered inside the hedge delay: no hedge, and the
+            # result (of whatever kind) follows the plain taxonomy.
+            outstanding.pop(res[0], None)
+            return res
+        except _pyqueue.Empty:
+            pass
+        # Primary still out past the delay: presume straggler, hedge
+        # once. Never the same rank (exclude everything tried); a hedge
+        # is issued only while the primary is in flight — a terminal
+        # result never spawns one, so lost-is-lost survives.
+        h_rank = pick_replica(
+            snaps,
+            policy=self.policy,
+            candidates=self.affinity.candidates(digest),
+            exclude=set(tried) | set(outstanding),
+            rr_state=self._rr,
+        )
+        if h_rank is not None:
+            tried.add(h_rank)
+            self._bump("hedged")
+            self._note(h_rank, "hedged")
+            _events.annotate(
+                "fleet.hedge", primary=rank, hedge=h_rank, tier=tier,
+                delay_s=round(delay, 4),
+            )
+            spawn(
+                h_rank, snaps[h_rank].port,
+                max(remaining - (self.clock() - t_call), 0.01),
+            )
+        collected: list[tuple[int, str, int | None, dict]] = []
+        while outstanding:
+            wait_s = max(
+                remaining - (self.clock() - t_call), 0.0
+            ) + 35.0  # outlast every attempt's own socket timeout
+            try:
+                res = results.get(timeout=wait_s)
+            except _pyqueue.Empty:
+                # Unreachable in practice (attempts time out first);
+                # declare the stragglers lost rather than hang forever.
+                for d_rank in list(outstanding):
+                    outstanding.pop(d_rank)
+                    collected.append((
+                        d_rank, "lost", None,
+                        {"error": "hedge wait timed out"},
+                    ))
+                break
+            outstanding.pop(res[0], None)
+            if res[1] == "ok":
+                # First response wins. Reap the still-running loser, and
+                # book any already-arrived non-ok sibling so the
+                # per-replica taxonomy stays truthful.
+                for l_rank, l_port in outstanding.items():
+                    self._cancel_loser(l_rank, l_port, ctx)
+                for c in collected:
+                    self._absorb_hedge_result(c)
+                return res
+            collected.append(res)
+        severity = {
+            "lost": 0, "failed": 0, "expired": 0,
+            "backpressure": 1, "refused": 2,
+        }
+        collected.sort(key=lambda c: severity.get(c[1], 0))
+        head, rest = collected[0], collected[1:]
+        for c in rest:
+            self._absorb_hedge_result(c)
+        return head
+
+    def _absorb_hedge_result(
+        self, res: tuple[int, str, int | None, dict]
+    ) -> None:
+        """Book a hedge sibling's non-winning, non-returned outcome:
+        per-replica taxonomy and penalty-box effects still apply, but it
+        contributes no request-level terminal bucket — that is its
+        sibling's job, and the conservation law demands exactly one."""
+        r_rank, kind, _status, _payload = res
+        if kind == "refused":
+            self._box(r_rank)
+            self.affinity.forget_rank(r_rank)
+            self._note(r_rank, "refused")
+        elif kind == "backpressure":
+            self._note(r_rank, "backpressure")
+        elif kind == "lost":
+            self._box(r_rank)
+            self._note(r_rank, "lost")
+        elif kind in ("failed", "expired"):
+            self._note(r_rank, kind)
+
+    def _cancel_loser(self, rank: int, port: int, ctx) -> None:
+        """The race is decided: reap the outstanding duplicate so it
+        stops burning pages and launch slots. Fire-and-forget on a
+        helper thread — the winner's response must not wait on the
+        loser's socket. With tracing off there is no shared trace-id
+        key, so the loser simply runs out its own clock (correctness is
+        unaffected; only the dead-work savings are forfeited)."""
+        if ctx is None:
+            return
+        self._note(rank, "cancelled")
+        self._bump("cancelled")
+        threading.Thread(
+            target=ReplicaClient.cancel, args=(port, ctx.trace_id),
+            name=f"fleet-cancel-{rank}", daemon=True,
+        ).start()
 
     # -- accounting ----------------------------------------------------------
     def _observe_slo(self, tier: str, missed: bool) -> None:
@@ -492,6 +756,7 @@ class FleetRouter:
             row = self._per_replica.setdefault(rank, {
                 "dispatched": 0, "completed": 0, "refused": 0,
                 "backpressure": 0, "failed": 0, "lost": 0,
+                "expired": 0, "hedged": 0, "cancelled": 0,
             })
             row[event] = row.get(event, 0) + 1
 
@@ -503,10 +768,16 @@ class FleetRouter:
                 "rejected": self.rejected,
                 "unavailable": self.unavailable,
                 "failed": self.failed,
+                "expired": self.expired,
+                # Attempt-level hedge taxonomy — informational, outside
+                # the conservation sum (a hedged request still lands in
+                # exactly one terminal bucket above).
+                "hedged": self.hedged,
+                "cancelled": self.cancelled,
             }
         out["in_flight"] = (
             out["submitted"] - out["completed"] - out["rejected"]
-            - out["unavailable"] - out["failed"]
+            - out["unavailable"] - out["failed"] - out["expired"]
         )
         return out
 

@@ -599,6 +599,12 @@ class ServingEngine:
             timeout=timeout,
             cost_fn=self._admission_cost,
         )
+        if not taken:
+            # Empty admit round: no arrival will run the submit-side
+            # sweep, so burn deadlines down directly — a queued request
+            # must expire on time even on a quiet engine.
+            self.queue.expire_now()
+            return
         for i, req in enumerate(taken):
             if self._stop.is_set():
                 self.queue.requeue_front(taken[i:])
@@ -641,6 +647,32 @@ class ServingEngine:
                 ))
                 self.metrics.on_failure(1)
                 self.metrics.on_trace(req)
+        # Deadline sweep between launches: a row whose deadline passed
+        # (or was force-expired by /v1/cancel) retires NOW, freeing its
+        # pages and launch slot instead of decoding tokens no one will
+        # read. Same retire path as completion, outcome ``expired`` — the
+        # conservation ledger closes either way.
+        now = self.clock()
+        n_reaped = 0
+        for row, req in self.runtime.active_rows():
+            if not req.expired(now):
+                continue
+            self.runtime.retire(row)
+            self.pool.release_owner(req.id)
+            n_reaped += 1
+            if not req.future.done():
+                req.trace.mark("expire", now, reason="in_flight")
+                req.future.set_exception(DeadlineExceeded(
+                    f"request {req.id} expired mid-decode after "
+                    f"{now - req.submit_time:.3f}s"
+                ))
+                self.metrics.on_expire(1, in_flight=True)
+                self.metrics.on_slo(req.tier, True)
+                self.metrics.on_trace(req)
+        if n_reaped:
+            telemetry.annotate(
+                "serving.expire_in_flight", mode="paged", count=n_reaped
+            )
         active = self.runtime.active_requests()
         n_active = len(active)
         if n_active == 0:

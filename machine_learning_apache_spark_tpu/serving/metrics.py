@@ -205,6 +205,11 @@ class ServingMetrics:
         self.completed = 0
         self.rejected = 0
         self.expired = 0
+        # expired_in_flight ⊆ expired — requests reaped *mid-decode* by
+        # the engine's between-launch deadline sweep (including remote
+        # /v1/cancel force-expiry), as opposed to expiring in queue.
+        # Dead work the cancellation path actually saved, made visible.
+        self.expired_in_flight = 0
         self.failed = 0
         # containment counters (engine._quarantine / supervisor restart):
         # quarantined ⊆ failed — requests failed by a contained batch
@@ -266,9 +271,11 @@ class ServingMetrics:
             self.rejected += 1
         self._reg_counters["rejected"].inc()
 
-    def on_expire(self, n: int = 1) -> None:
+    def on_expire(self, n: int = 1, *, in_flight: bool = False) -> None:
         with self._lock:
             self.expired += n
+            if in_flight:
+                self.expired_in_flight += n
         self._reg_counters["expired"].inc(n)
 
     def on_failure(self, n: int = 1) -> None:
@@ -472,6 +479,7 @@ class ServingMetrics:
             "completed": self.completed,
             "rejected": self.rejected,
             "expired": self.expired,
+            "expired_in_flight": self.expired_in_flight,
             "failed": self.failed,
             "quarantined": self.quarantined,
             "loop_restarts": self.loop_restarts,

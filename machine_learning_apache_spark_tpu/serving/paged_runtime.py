@@ -624,6 +624,16 @@ class PagedDecodeRuntime:
     def active_requests(self) -> list:
         return [r for r in self._req_of_row if r is not None]
 
+    def active_rows(self) -> list:
+        """``(row, request)`` pairs for every occupied row — the engine's
+        between-launch deadline sweep walks this to :meth:`retire` expired
+        rows without reaching into private row state."""
+        return [
+            (row, req)
+            for row, req in enumerate(self._req_of_row)
+            if req is not None
+        ]
+
     def reset(self) -> list:
         """Quarantine path: the store's contents are suspect, so drop
         everything — returns the requests that were active (the caller

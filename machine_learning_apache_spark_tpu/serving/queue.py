@@ -320,6 +320,19 @@ class RequestQueue:
         with self.cond:
             return len(self._expire_locked(self.clock()))
 
+    def expire_now(self) -> int:
+        """Immediate deadline sweep, callable from any thread — the
+        batcher fires it when an admit round comes back empty, and the
+        replica's ``/v1/cancel`` path fires it after force-expiring a
+        queued request, so deadlines burn down even when no arriving
+        traffic triggers the submit-side sweep. Wakes any consumer
+        blocked in a timed wait so it re-evaluates the shrunken queue."""
+        with self.cond:
+            dead = self._expire_locked(self.clock())
+            if dead:
+                self.cond.notify_all()
+            return len(dead)
+
     # -- feedback / introspection -------------------------------------------
     def note_serviced(self, n_requests: int, elapsed: float) -> None:
         """Engine feedback after each batch: fold observed per-request

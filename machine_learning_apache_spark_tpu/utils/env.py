@@ -360,6 +360,29 @@ register(
     "MLSPARK_FLEET_BATCH_MAX_IN_FLIGHT", type="int", default=256, subsystem="fleet",
     description="In-flight cap for the `batch` SLO tier.",
 )
+register(
+    "MLSPARK_FLEET_HEDGE", type="bool", default=False, subsystem="fleet",
+    description="Enable straggler hedging: after the hedge delay, the "
+    "router issues a duplicate dispatch to a second healthy replica; "
+    "first response wins, the loser is cancelled via /v1/cancel.",
+)
+register(
+    "MLSPARK_FLEET_HEDGE_TIERS", type="str", default="interactive", subsystem="fleet",
+    description="Comma-separated SLO tiers eligible for hedging "
+    "(latency-sensitive tiers only by default; batch work rides the "
+    "plain retry taxonomy).",
+)
+register(
+    "MLSPARK_FLEET_HEDGE_DELAY_FACTOR", type="float", default=3.0, subsystem="fleet",
+    description="Hedge delay as a multiple of the admission layer's "
+    "observed service-time EWMA — a dispatch outstanding this much "
+    "longer than typical is presumed straggling.",
+)
+register(
+    "MLSPARK_FLEET_HEDGE_MIN_DELAY_S", type="float", default=0.05, subsystem="fleet",
+    description="Floor on the hedge delay, so a cold or noisy EWMA "
+    "cannot make every request fan out twice.",
+)
 
 # fleet autoscaling (closed loop: SLO burn / queue depth -> replica count)
 register(

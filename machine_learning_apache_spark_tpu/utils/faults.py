@@ -27,6 +27,25 @@ rank=6,...`` kills one rank per world size, so each shrunken gang
 meets exactly its own fault and the drill walks 8 -> 7 -> 6
 deterministically.
 
+**Wire faults.** A second action family targets one HTTP exchange on
+the fleet data plane instead of a process::
+
+    delay@wire:rank=1,ms=500          # hold the exchange 500ms (straggler)
+    blackhole@wire:rank=0,req=3       # swallow the request, never respond
+    torn@wire:rank=0,req=2            # full Content-Length, half a body
+    corrupt@wire:rank=1,req=5         # right length, unparseable JSON
+    drip@wire:rank=0,req=1,ms=2000    # trickle the body out over 2s
+
+Wire specs live only at the ``wire`` site and are *queried* (via
+:func:`wire_fault`) by ``ReplicaServer``'s request handler, which
+implements the behavior itself — ``maybe_fault`` never executes them.
+Coordinates are deterministic: ``rank`` is the replica's rank, ``req``
+the zero-based ordinal of the exchange on that server (absent = every
+exchange). ``ms`` is the action's magnitude (delay/drip duration).
+``sticky=1`` exempts a spec from one-shot semantics — the persistent
+slow replica a straggler-hedging drill needs; the marker file still
+records the first firing as proof.
+
 **One-shot semantics.** A fault fires once. In-process that's a set of
 fired keys; across process restarts (the gang-retry case — the retried
 worker re-executes the same step numbers) it's a marker file under
@@ -61,6 +80,8 @@ ENV_PLAN = "MLSPARK_FAULTS"
 ENV_MARKER_DIR = "MLSPARK_FAULTS_DIR"
 
 _ACTIONS = ("crash", "raise", "stall")
+WIRE_ACTIONS = ("delay", "blackhole", "torn", "corrupt", "drip")
+WIRE_SITE = "wire"
 
 
 class FaultInjected(RuntimeError):
@@ -79,6 +100,9 @@ class FaultSpec:
     step: int | None = None
     batch: int | None = None
     world: int | None = None
+    req: int | None = None
+    ms: int = 0
+    sticky: int = 0
     exit_code: int = 23
 
     @property
@@ -90,15 +114,18 @@ class FaultSpec:
             f"_s{'any' if self.step is None else self.step}"
             f"_b{'any' if self.batch is None else self.batch}"
             + ("" if self.world is None else f"_w{self.world}")
+            + ("" if self.req is None else f"_q{self.req}")
+            + ("" if not self.ms else f"_m{self.ms}")
         )
 
     def matches(self, site: str, rank: int | None, step: int | None,
-                batch: int | None, world: int | None = None) -> bool:
+                batch: int | None, world: int | None = None,
+                req: int | None = None) -> bool:
         if self.site != site:
             return False
         for want, got in (
             (self.rank, rank), (self.step, step), (self.batch, batch),
-            (self.world, world),
+            (self.world, world), (self.req, req),
         ):
             if want is not None and want != got:
                 return False
@@ -120,18 +147,24 @@ class FaultPlan:
         specs = []
         for entry in filter(None, (e.strip() for e in text.split(";"))):
             action, _, rest = entry.partition("@")
-            if action not in _ACTIONS:
+            if action not in _ACTIONS and action not in WIRE_ACTIONS:
                 raise ValueError(
                     f"unknown fault action {action!r} in {entry!r} "
-                    f"(expected one of {_ACTIONS})"
+                    f"(expected one of {_ACTIONS + WIRE_ACTIONS})"
                 )
             site, _, kvs = rest.partition(":")
             if not site:
                 raise ValueError(f"fault entry {entry!r} has no site")
+            if (action in WIRE_ACTIONS) != (site == WIRE_SITE):
+                raise ValueError(
+                    f"fault entry {entry!r}: wire actions {WIRE_ACTIONS} "
+                    f"pair only with site {WIRE_SITE!r} and vice versa"
+                )
             fields: dict = {"action": action, "site": site}
             for kv in filter(None, (p.strip() for p in kvs.split(","))):
                 k, _, v = kv.partition("=")
-                if k not in ("rank", "step", "batch", "world", "exit_code"):
+                if k not in ("rank", "step", "batch", "world", "req", "ms",
+                             "sticky", "exit_code"):
                     raise ValueError(f"unknown fault field {k!r} in {entry!r}")
                 fields[k] = int(v)
             specs.append(FaultSpec(**fields))
@@ -183,16 +216,23 @@ class FaultPlan:
 
     def pending(self, site: str, *, rank: int | None = None,
                 step: int | None = None, batch: int | None = None,
-                world: int | None = None) -> FaultSpec | None:
-        """The first matching not-yet-fired spec, or None. Marks it fired."""
+                world: int | None = None,
+                req: int | None = None) -> FaultSpec | None:
+        """The first matching not-yet-fired spec, or None. Marks it fired.
+
+        ``sticky`` specs are exempt from one-shot consumption: they match
+        on every call, but the marker is still written once so a drill
+        can prove the fault actually engaged."""
         with self._lock:
             for spec in self.specs:
-                if (
-                    spec.matches(site, rank, step, batch, world)
-                    and not self._already_fired(spec)
-                ):
+                if not spec.matches(site, rank, step, batch, world, req):
+                    continue
+                fired = self._already_fired(spec)
+                if fired and not spec.sticky:
+                    continue
+                if not fired:
                     self._mark_fired(spec)
-                    return spec
+                return spec
         return None
 
 
@@ -253,6 +293,11 @@ def maybe_fault(site: str, *, step: int | None = None,
     process's ``MLSPARK_PROCESS_ID``, ``world`` to
     ``MLSPARK_NUM_PROCESSES`` (how elastic drills pin a fault to one
     world size along the shrink path)."""
+    if site == WIRE_SITE:
+        raise ValueError(
+            "wire faults are queried via wire_fault(), not executed by "
+            "maybe_fault() — the HTTP handler owns the behavior"
+        )
     plan = active_plan()
     if plan is None:
         return
@@ -295,15 +340,45 @@ def maybe_fault(site: str, *, step: int | None = None,
             time.sleep(3600)
 
 
+def wire_fault(*, rank: int | None = None,
+               req: int | None = None) -> FaultSpec | None:
+    """Query the plan for a wire fault matching this HTTP exchange.
+
+    Unlike :func:`maybe_fault` this *returns* the matched spec instead of
+    executing it — wire behaviors (delay / black-hole / torn / corrupt /
+    drip) are implemented by the caller (``ReplicaServer``'s handler),
+    which owns the socket. ``rank`` defaults to ``MLSPARK_PROCESS_ID``;
+    ``req`` is the caller's per-server exchange ordinal. One-shot (or
+    sticky) bookkeeping is consumed exactly as for process faults."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    spec = plan.pending(
+        WIRE_SITE, rank=_env_rank() if rank is None else rank, req=req,
+    )
+    if spec is None or spec.action not in WIRE_ACTIONS:
+        # A crash/raise/stall spec can never parse with site "wire", so a
+        # non-wire action here means a hand-built plan; refuse quietly.
+        return None
+    if spec.key not in getattr(wire_fault, "_logged", set()):
+        wire_fault._logged = getattr(wire_fault, "_logged", set()) | {spec.key}
+        _log().warning("wire fault engaging: %s (rank=%s req=%s)",
+                       spec.key, rank, req)
+    return spec
+
+
 __all__ = [
     "ENV_MARKER_DIR",
     "ENV_PLAN",
     "FaultInjected",
     "FaultPlan",
     "FaultSpec",
+    "WIRE_ACTIONS",
+    "WIRE_SITE",
     "active_plan",
     "clear",
     "heartbeats_suspended",
     "install",
     "maybe_fault",
+    "wire_fault",
 ]

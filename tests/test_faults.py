@@ -298,3 +298,90 @@ class TestServingPoisonedBatch:
             assert eng.recompiles_after_warmup == 0
         finally:
             eng.stop()
+
+
+class TestWireFaults:
+    """The ``wire`` site family (fleet data-plane injection): grammar,
+    exchange-coordinate matching, and sticky-vs-one-shot semantics —
+    the unit layer under ``fault_drill.py``'s socket-level scenarios."""
+
+    def test_wire_grammar_and_key(self):
+        plan = FaultPlan.from_spec(
+            "delay@wire:rank=1,ms=800,sticky=1;torn@wire:rank=0,req=2"
+        )
+        d, t = plan.specs
+        assert d.action == "delay" and d.site == "wire"
+        assert d.ms == 800 and d.sticky == 1
+        assert d.key == "delay_wire_r1_sany_bany_m800"
+        assert t.req == 2 and not t.sticky
+        assert t.key == "torn_wire_r0_sany_bany_q2"
+
+    def test_wire_actions_pair_only_with_wire_site(self):
+        with pytest.raises(ValueError, match="wire"):
+            FaultPlan.from_spec("torn@train_step:rank=0")
+        with pytest.raises(ValueError, match="wire"):
+            FaultPlan.from_spec("crash@wire:rank=0")
+
+    def test_wire_fault_matches_exchange_coordinates(self):
+        faults.install(FaultPlan.from_spec("torn@wire:rank=1,req=2"))
+        assert faults.wire_fault(rank=0, req=2) is None
+        assert faults.wire_fault(rank=1, req=1) is None
+        spec = faults.wire_fault(rank=1, req=2)
+        assert spec is not None and spec.action == "torn"
+        # one-shot: the exchange that matched consumed it
+        assert faults.wire_fault(rank=1, req=2) is None
+
+    def test_sticky_wire_fault_fires_every_exchange_marker_once(
+        self, tmp_path
+    ):
+        # A sticky delay (the straggler impersonation) engages on EVERY
+        # exchange, but the drill's proof-of-engagement marker is still
+        # written exactly once.
+        faults.install(FaultPlan.from_spec(
+            "delay@wire:rank=1,ms=5,sticky=1", marker_dir=str(tmp_path),
+        ))
+        for q in range(3):
+            spec = faults.wire_fault(rank=1, req=q)
+            assert spec is not None and spec.ms == 5
+        assert [p.name for p in tmp_path.iterdir()] == [
+            "delay_wire_r1_sany_bany_m5"
+        ]
+
+    def test_wire_fault_no_plan_is_noop(self):
+        assert faults.wire_fault(rank=0, req=0) is None
+
+
+def test_fault_drill_wire_smoke_subprocess(tmp_path):
+    """tools/fault_drill.py --smoke: the two wire-level scenarios end to
+    end over real sockets — a sticky-delayed replica rescued by hedging
+    (losers reaped via /v1/cancel) and a torn 200 surfacing as a
+    terminal failure with no silent replay — each gated on ledger
+    conservation and exactly-once completion per request id."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "fault_smoke.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo_root, "tools", "fault_drill.py"),
+            "--smoke", "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    artifact = json.loads(out.read_text())
+    assert artifact["all_ok"] is True and artifact["smoke"] is True
+    by_name = {s["scenario"]: s for s in artifact["scenarios"]}
+    assert set(by_name) == {"straggler_hedge", "torn_response_retry"}
+    hedge = by_name["straggler_hedge"]
+    assert hedge["ok"] is True
+    assert hedge["ledger"]["hedged"] >= 1
+    assert hedge["ledger"]["cancelled"] >= 1
+    torn = by_name["torn_response_retry"]
+    assert torn["ok"] is True
+    assert torn["ledger"]["failed"] == 1 and torn["router_retries"] == 0

@@ -36,7 +36,10 @@ from machine_learning_apache_spark_tpu.fleet import (
     write_fleet_sidecar,
 )
 from machine_learning_apache_spark_tpu.fleet.router import AFFINITY_LOAD_SLACK
-from machine_learning_apache_spark_tpu.serving.queue import Backpressure
+from machine_learning_apache_spark_tpu.serving.queue import (
+    Backpressure,
+    DeadlineExceeded,
+)
 
 pytestmark = pytest.mark.fleet
 
@@ -326,6 +329,17 @@ class _FakeEngine:
     def __init__(self):
         self.mode = "ok"
         self.submitted = []
+        self.clock = time.monotonic
+        self.expire_sweeps = 0
+        eng = self
+
+        class _Q:
+            @staticmethod
+            def expire_now():
+                eng.expire_sweeps += 1
+                return 0
+
+        self.queue = _Q()
         pipe = type("P", (), {"ragged": staticmethod(
             lambda texts: [[1, 2, 3] for _ in texts]
         )})()
@@ -367,6 +381,20 @@ def _post(port, payload, timeout=5.0):
         return e.code, json.loads(e.read().decode()), dict(e.headers)
 
 
+def _post_cancel(port, payload, timeout=5.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/cancel",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
 class TestReplicaServer:
     def test_generate_roundtrip_and_sidecar(self, replica):
         server, eng, _, d = replica
@@ -403,6 +431,36 @@ class TestReplicaServer:
     def test_bad_body_400(self, replica):
         server, _, _, _ = replica
         code, payload, _ = _post(server.port, {"nope": 1})
+        assert code == 400
+
+    def test_cancel_unknown_trace_id_404(self, replica):
+        # Best-effort by contract: a cancel that races a completed (or
+        # never-arrived) request answers 404, touches nothing.
+        server, eng, _, _ = replica
+        code, payload = _post_cancel(server.port, {"trace_id": "nope"})
+        assert code == 404 and payload["cancelled"] is False
+        assert server.stats()["cancelled"] == 0
+        assert eng.expire_sweeps == 0
+
+    def test_cancel_in_flight_force_expires(self, replica):
+        # Seed an in-flight entry the way generate does, then reap it
+        # over the wire: the deadline snaps to "now" (the engine's next
+        # sweep books ``expired``) and the queued-work sweep fires.
+        server, eng, _, _ = replica
+        victim = _FakeReq("slow")
+        victim.deadline = eng.clock() + 120.0
+        with server._lock:
+            server._inflight["t-cancel"] = victim
+        code, payload = _post_cancel(server.port, {"trace_id": "t-cancel"})
+        assert code == 200 and payload["cancelled"] is True
+        assert payload["trace_id"] == "t-cancel"
+        assert victim.deadline <= eng.clock()  # pulled to the past
+        assert eng.expire_sweeps == 1
+        assert server.stats()["cancelled"] == 1
+
+    def test_cancel_bad_body_400(self, replica):
+        server, _, _, _ = replica
+        code, payload = _post_cancel(server.port, {"nope": 1})
         assert code == 400
 
 
@@ -462,7 +520,8 @@ class TestRouterDispatch:
         assert fleet.calls == [(1, "hi")]
         assert router.check_conservation() == {
             "submitted": 1, "completed": 1, "rejected": 0,
-            "unavailable": 0, "failed": 0, "in_flight": 0,
+            "unavailable": 0, "failed": 0, "expired": 0,
+            "hedged": 0, "cancelled": 0, "in_flight": 0,
         }
 
     def test_drains_around_503_until_recovery(self, scripted):
@@ -531,6 +590,17 @@ class TestRouterDispatch:
         assert router.ledger()["rejected"] == 1
         adm.release(held)
         assert router.submit("x")["rank"] == 0
+
+    def test_pre_dispatch_deadline_expires_locally(self, scripted):
+        # A request whose budget is gone before any dispatch fails HERE
+        # as ``expired`` — no replica ever decodes for it.
+        snaps = {0: snap(0)}
+        fleet, router = scripted({}, snapshots=snaps)
+        with pytest.raises(DeadlineExceeded, match="before"):
+            router.submit("x", deadline_s=0.0)
+        assert fleet.calls == []  # never reached a replica
+        ledger = router.check_conservation()
+        assert ledger["expired"] == 1 and ledger["completed"] == 0
 
     def test_affinity_routing_memory_steers_repeat_prompts(self, scripted):
         snaps = {0: snap(0, in_flight=1), 1: snap(1, in_flight=0)}
@@ -637,6 +707,150 @@ class TestRouterTracing:
 
         snap_reg = registry.get_registry().snapshot()
         assert "slo_burn_interactive" in snap_reg["fleet"]
+
+
+class TestRouterHedging:
+    """Straggler hedging on the scripted fleet: the duplicate fires only
+    past the hedge delay, first response wins, the loser is reaped via
+    /v1/cancel, and a hedged request still retires in exactly ONE
+    terminal ledger bucket (``hedged``/``cancelled`` ride outside the
+    conservation sum)."""
+
+    def _reap_log(self, monkeypatch):
+        reaps = []
+        from machine_learning_apache_spark_tpu.fleet import router as rmod
+
+        monkeypatch.setattr(
+            rmod.ReplicaClient, "cancel",
+            staticmethod(
+                lambda port, trace_id, **kw:
+                reaps.append((port, trace_id)) or True
+            ),
+        )
+        return reaps
+
+    def test_hedge_rescues_straggler_and_cancels_loser(
+        self, scripted, monkeypatch, fresh_trace
+    ):
+        snaps = {0: snap(0, in_flight=0), 1: snap(1, in_flight=3)}
+
+        def slow_ok():
+            time.sleep(0.6)
+            return "ok"
+
+        fleet, router = scripted(
+            {0: slow_ok}, snapshots=snaps,
+            hedge=True, hedge_tiers=("interactive",),
+            hedge_delay_factor=0.0, hedge_min_delay_s=0.05,
+        )
+        reaps = self._reap_log(monkeypatch)
+        out = router.submit("hi", tier="interactive")
+        assert out["rank"] == 1  # the hedge won the race
+        ledger = router.check_conservation()
+        assert ledger["completed"] == 1
+        assert ledger["hedged"] == 1 and ledger["cancelled"] == 1
+        stats = router.stats()
+        assert stats["per_replica"][1]["hedged"] == 1
+        assert stats["per_replica"][0]["cancelled"] == 1
+        # the reap is fire-and-forget on a helper thread: wait for it,
+        # then check it targeted the straggler's port with the shared
+        # router-minted trace id (the /v1/cancel key).
+        deadline = time.time() + 5.0
+        while not reaps and time.time() < deadline:
+            time.sleep(0.01)
+        assert reaps == [(10000, reaps[0][1])] and reaps[0][1]
+
+    def test_fast_primary_never_hedges(
+        self, scripted, monkeypatch, fresh_trace
+    ):
+        snaps = {0: snap(0, in_flight=0), 1: snap(1, in_flight=3)}
+        fleet, router = scripted(
+            {}, snapshots=snaps,
+            hedge=True, hedge_tiers=("interactive",),
+            hedge_delay_factor=0.0, hedge_min_delay_s=0.25,
+        )
+        reaps = self._reap_log(monkeypatch)
+        assert router.submit("hi")["rank"] == 0
+        ledger = router.check_conservation()
+        assert ledger["hedged"] == 0 and ledger["cancelled"] == 0
+        assert len(fleet.calls) == 1 and reaps == []
+
+    def test_hedge_scoped_to_configured_tiers(
+        self, scripted, fresh_trace
+    ):
+        snaps = {0: snap(0, in_flight=0), 1: snap(1, in_flight=3)}
+
+        def slow_ok():
+            time.sleep(0.3)
+            return "ok"
+
+        fleet, router = scripted(
+            {0: slow_ok}, snapshots=snaps,
+            hedge=True, hedge_tiers=("interactive",),
+            hedge_delay_factor=0.0, hedge_min_delay_s=0.02,
+        )
+        # batch is not a hedged tier: the slow primary is simply waited
+        # out, no duplicate dispatch.
+        assert router.submit("hi", tier="batch")["rank"] == 0
+        assert router.ledger()["hedged"] == 0
+        assert len(fleet.calls) == 1
+
+    def test_hedge_saves_lost_primary_without_replay(
+        self, scripted, fresh_trace
+    ):
+        # The socket dies under the primary AFTER the hedge is already
+        # in flight: the hedge's 200 wins, the lost sibling is absorbed
+        # (rank boxed, per-replica taxonomy booked) — but lost-is-lost
+        # still holds in that nothing was REPLAYED in response to the
+        # loss; the rescue rode a duplicate issued before it.
+        snaps = {0: snap(0, in_flight=0), 1: snap(1, in_flight=3)}
+
+        def slow_lost():
+            time.sleep(0.2)
+            return "lost"
+
+        def slow_ok():
+            time.sleep(0.3)
+            return "ok"
+
+        fleet, router = scripted(
+            {0: slow_lost, 1: slow_ok}, snapshots=snaps,
+            hedge=True, hedge_tiers=("interactive",),
+            hedge_delay_factor=0.0, hedge_min_delay_s=0.05,
+        )
+        out = router.submit("hi", tier="interactive")
+        assert out["rank"] == 1
+        ledger = router.check_conservation()
+        assert ledger["completed"] == 1 and ledger["failed"] == 0
+        assert ledger["hedged"] == 1
+        stats = router.stats()
+        assert stats["down"] == [0]  # the dead socket still boxes
+        assert stats["per_replica"][0]["lost"] == 1
+        assert len(fleet.calls) == 2  # primary + one hedge, no third
+
+    def test_hedge_both_fail_single_terminal(
+        self, scripted, fresh_trace
+    ):
+        # No winner: the sibling outcomes reduce to ONE terminal result
+        # (severity: terminal > backpressure > refused) — the ledger
+        # books exactly one failure for the request.
+        snaps = {0: snap(0, in_flight=0), 1: snap(1, in_flight=3)}
+
+        def slow_failed():
+            time.sleep(0.2)
+            return "failed"
+
+        fleet, router = scripted(
+            {0: slow_failed, 1: "failed"}, snapshots=snaps,
+            hedge=True, hedge_tiers=("interactive",),
+            hedge_delay_factor=0.0, hedge_min_delay_s=0.05,
+        )
+        with pytest.raises(FleetRequestFailed):
+            router.submit("hi", tier="interactive")
+        ledger = router.check_conservation()
+        assert ledger["failed"] == 1 and ledger["completed"] == 0
+        assert ledger["hedged"] == 1 and ledger["cancelled"] == 0
+        assert len(fleet.calls) == 2
 
 
 @pytest.fixture(scope="module")

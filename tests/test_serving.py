@@ -78,6 +78,27 @@ class TestRequestQueue:
         with pytest.raises(DeadlineExceeded):
             r.result(timeout=0)
 
+    def test_expire_now_sweeps_without_traffic(self):
+        # The /v1/cancel + empty-admit-round hook: deadlines burn down
+        # even when no arriving submit triggers the admission-side sweep.
+        clock = FakeClock()
+        q = RequestQueue(max_depth=4, clock=clock)
+        r1 = q.submit("a", [1], deadline_s=1.0)
+        r2 = q.submit("b", [2], deadline_s=10.0)
+        assert q.expire_now() == 0  # nothing overdue yet
+        clock.advance(2.0)
+        assert q.expire_now() == 1  # no submit needed to reap r1
+        with pytest.raises(DeadlineExceeded):
+            r1.result(timeout=0)
+        assert not r2.future.done()
+        assert q.expired == 1 and q.depth == 1
+        # a force-expired deadline (the remote-cancel mechanic) reaps too
+        r2.deadline = clock() - 0.001
+        assert q.expire_now() == 1
+        with pytest.raises(DeadlineExceeded):
+            r2.result(timeout=0)
+        assert q.expired == 2 and q.depth == 0
+
     def test_fail_all_drains(self):
         q = RequestQueue(max_depth=4)
         rs = [q.submit(str(i), [i]) for i in range(3)]
@@ -1193,4 +1214,85 @@ class TestObservabilityPlane:
             assert not failures, failures
             assert len(outs) == 24 and ledgers
             assert max(led["submitted"] for led in ledgers) <= 24
+            eng.metrics.check_conservation(in_flight=0)
+
+
+class TestPagedCancellation:
+    """Satellite of the fleet cancellation tentpole: the engine-side reap
+    (the mechanic behind ``POST /v1/cancel`` and deadline burn-down) must
+    leave NO residue — pages, launch slots, prefix-cache refcounts, and
+    the compiled program set all return exactly to their pre-wave state,
+    and the conservation ledger still closes."""
+
+    @staticmethod
+    def _prefix_refcounts(runtime):
+        """Cache key -> per-page refcounts, via the pool's public
+        refcount probe (entry enumeration is unavoidably internal)."""
+        cache = runtime.prefix_cache
+        with cache._lock:
+            pages = {k: list(e["pages"]) for k, e in cache._entries.items()}
+        return {
+            k: [runtime.mem_pool.refcount(p) for p in ps]
+            for k, ps in pages.items()
+        }
+
+    @pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+    def test_cancel_mid_decode_restores_pool_and_cache(
+        self, tiny_translator, kv_dtype
+    ):
+        t, texts = tiny_translator
+        wave = texts[:4]
+        with t.serve(
+            boundaries=(8, 16), max_batch=4, max_wait_s=0.01,
+            max_new_tokens=8, kv_mode="paged", kv_dtype=kv_dtype,
+            steps_per_launch=1,
+        ) as eng:
+            # Warm wave: completes normally and seeds the prefix cache,
+            # so the baseline below includes cached (shared) pages.
+            for f in [eng.submit(s, deadline_s=120.0) for s in wave]:
+                f.result(timeout=120)
+            base_in_use = eng.runtime.mem_pool.in_use
+            base_refs = self._prefix_refcounts(eng.runtime)
+            assert eng.pool.in_use == 0
+            assert eng.recompiles_after_warmup == 0
+
+            # Cancel wave: same prompts, generous deadline. As soon as a
+            # row goes active, pull its deadline to the past — exactly
+            # what ReplicaServer.cancel does — and let the engine's
+            # between-launch sweep (every step: steps_per_launch=1) reap
+            # it instead of decoding tokens nobody will read.
+            futs = [eng.submit(s, deadline_s=120.0) for s in wave]
+            cancelled = set()
+            t_end = time.time() + 30.0
+            while len(cancelled) < len(wave) and time.time() < t_end:
+                for _row, req in eng.runtime.active_rows():
+                    if req.id not in cancelled:
+                        req.deadline = 0.0
+                        cancelled.add(req.id)
+                time.sleep(0.001)
+            assert len(cancelled) == len(wave)
+            n_expired = 0
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                except DeadlineExceeded:
+                    n_expired += 1
+            # A ~1ms poll against one-step launches: every row is seen
+            # and reaped before it can decode to completion.
+            assert n_expired == len(wave)
+            assert eng.metrics.expired_in_flight >= 1
+
+            # Hygiene: everything the cancelled wave held is back.
+            assert eng.runtime.mem_pool.in_use == base_in_use
+            assert self._prefix_refcounts(eng.runtime) == base_refs
+            assert eng.pool.in_use == 0
+            assert eng.recompiles_after_warmup == 0
+            eng.metrics.check_conservation(in_flight=0)
+
+            # The engine still serves cleanly after the reap wave — the
+            # cancelled rows left no poisoned state behind.
+            again = [eng.submit(s, deadline_s=120.0) for s in wave]
+            outs = [f.result(timeout=120) for f in again]
+            assert all(isinstance(o, str) for o in outs)
+            assert eng.recompiles_after_warmup == 0
             eng.metrics.check_conservation(in_flight=0)

@@ -1,4 +1,4 @@
-"""Fault drill — run the injection scenarios end to end, emit FAULTS_r05.json.
+"""Fault drill — run the injection scenarios end to end, emit FAULTS_r06.json.
 
 The executable form of docs/FAULT_TOLERANCE.md: each scenario arms a
 deterministic fault plan (``utils.faults``), runs the real subsystem
@@ -35,6 +35,26 @@ against it, and records what the robustness layer did about it:
   identical at every world size) within float tolerance of an unfaulted
   run's final loss.
 
+Round 6 adds the **wire** fault family (``utils.faults`` site ``wire``,
+applied inside each replica's HTTP handler by deterministic
+(rank, request-ordinal) coordinates):
+
+- ``straggler_hedge`` — rank 1 of a 2-replica fleet carries a sticky
+  1.5s wire delay on every exchange; with hedging on for the
+  interactive tier, every request whose primary lands on the slow rank
+  must be saved by a hedged duplicate on the fast rank (first response
+  wins, the loser is reaped via ``POST /v1/cancel``). All requests
+  complete, the ledger conserves with ``hedged``/``cancelled`` as
+  attempt-level side counters, and every returned trace id is distinct
+  (exactly-once completion per request id).
+- ``torn_response_retry`` — rank 1 tears exactly one response (full
+  Content-Length, half a body, hang up). The router must classify the
+  short read terminal-``lost`` and NOT silently replay it (the decode
+  already happened once — replaying would double-spend it); the
+  *client* retries under a fresh request id and completes elsewhere.
+  Exactly one ``failed`` in the ledger, zero router-level retries,
+  conservation closes, all completed trace ids distinct.
+
 Round 2 additionally asserts the flight recorder: every drilled failure
 must leave a non-empty ``flight_<rank>.json`` (dumped by ``maybe_fault``
 BEFORE the fault action executes — the failing step's span events ride
@@ -43,7 +63,8 @@ recorded in the artifact.
 
 Usage::
 
-    python tools/fault_drill.py [--out FAULTS_r05.json] [scenario ...]
+    python tools/fault_drill.py [--out FAULTS_r06.json] [scenario ...]
+    python tools/fault_drill.py --smoke   # tier-1: the two wire scenarios
 
 Exits nonzero if any scenario's invariant does not hold, so CI can gate
 on the drill the way it gates on the test suite.
@@ -626,6 +647,210 @@ def scenario_elastic_shrink(workdir: str) -> dict:
     }
 
 
+def _wait_replicas_drained(router, timeout: float = 60.0) -> bool:
+    """Poll the scrape plane until every replica reports zero in-flight
+    — hedge losers may still be decoding on the slow rank after the
+    winner's response already returned to the client."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snaps = (
+            router._scrape.tick() if router._scrape is not None
+            else router._snapshot_source()
+        )
+        if snaps and all((s.in_flight or 0) == 0 for s in snaps.values()):
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def scenario_straggler_hedge(workdir: str) -> dict:
+    """Hedging rescues a wire-level straggler without double-counting.
+
+    Rank 1 of a 2-replica fleet gets a *sticky* 1.5s wire delay on every
+    ``/v1/generate`` exchange (the fault plan rides to the replica
+    processes via the gang env; the driver's own plan slot stays empty).
+    The router runs round-robin with hedging enabled for the interactive
+    tier, so roughly every other request lands its primary on the slow
+    rank, outlives the hedge delay (a multiple of the admission EWMA,
+    far below 1.5s), gets ONE duplicate on the fast rank, and returns
+    the duplicate's response while the loser is reaped via
+    ``POST /v1/cancel``. Invariants: every request completes, at least
+    one hedge and one cancel were issued, nothing lands in
+    failed/expired/unavailable, the ledger conserves with zero
+    in-flight, and the returned trace ids are pairwise distinct —
+    exactly-once completion per request id even though some requests
+    were dispatched twice."""
+    import fleet_bench
+
+    t0 = time.monotonic()
+    n_requests = 8
+    plan = "delay@wire:rank=1,ms=1500,sticky=1"
+    translator, texts = fleet_bench.build_translator(tiny=True)
+    knobs = fleet_bench.bench_knobs(tiny=True)
+    markers = os.path.join(workdir, "markers")
+    os.makedirs(markers, exist_ok=True)
+    gang, router = fleet_bench.build_fleet(
+        2, os.path.join(workdir, "fleet"), tiny=True,
+        policy="round_robin", knobs=knobs,
+        extra_env={faults.ENV_PLAN: plan, faults.ENV_MARKER_DIR: markers},
+        router_kw=dict(
+            hedge=True, hedge_tiers=("interactive",),
+            hedge_delay_factor=3.0, hedge_min_delay_s=0.05,
+        ),
+    )
+    try:
+        payloads = []
+        for i in range(n_requests):
+            payloads.append(router.submit(
+                texts[i % len(texts)], tier="interactive", deadline_s=30.0,
+            ))
+        drained = _wait_replicas_drained(router)
+        conservation = fleet_bench.conservation_gate(router)
+        router_stats = router.stats()
+    finally:
+        router.stop()
+        gang.stop()
+    fired = sorted(os.listdir(markers)) if os.path.isdir(markers) else []
+    ledger = conservation["router_ledger"]
+    trace_ids = [p.get("trace_id") for p in payloads]
+    winner_ranks = sorted({p.get("rank") for p in payloads})
+    return {
+        "scenario": "straggler_hedge",
+        "plan": plan,
+        "fault_fired": fired,
+        "requests": n_requests,
+        "ledger": ledger,
+        "hedged": ledger["hedged"],
+        "cancelled": ledger["cancelled"],
+        "winner_ranks": winner_ranks,
+        "distinct_trace_ids": len(set(trace_ids)),
+        "replicas_drained": drained,
+        "conservation": conservation,
+        "per_replica": router_stats["per_replica"],
+        "wall_seconds": round(time.monotonic() - t0, 2),
+        "ok": (
+            # Sticky fault: marker written once as proof, fault re-fires.
+            any(f.startswith("delay_wire") for f in fired)
+            and ledger["submitted"] == n_requests
+            and ledger["completed"] == n_requests
+            and ledger["hedged"] >= 1
+            and ledger["cancelled"] >= 1
+            and ledger["failed"] == 0
+            and ledger["expired"] == 0
+            and ledger["unavailable"] == 0
+            and drained
+            and conservation["ok"]
+            and ledger["in_flight"] == 0
+            # Exactly-once per request id: one distinct trace per submit.
+            and len(set(trace_ids)) == n_requests
+            and all(t for t in trace_ids)
+        ),
+    }
+
+
+def scenario_torn_response_retry(workdir: str) -> dict:
+    """A torn response is terminal-lost; recovery is a NEW request id.
+
+    Rank 1 tears exactly one response (one-shot ``torn`` wire fault on
+    its first exchange): full Content-Length, half a body, hang up. The
+    replica *did* decode the request — so the router must classify the
+    short read ``lost`` and refuse to silently replay it (PR 11's
+    lost-is-lost: a replay would double-spend the decode and break
+    exactly-once). The client then retries under a fresh request id and
+    completes on the surviving rank (the torn rank sits in the penalty
+    box until a scrape clears it). Invariants: exactly one ``failed`` in
+    the ledger attributed to rank 1, zero router-level retries (the
+    failure surfaced, nothing was replayed), every submission lands in
+    exactly one terminal bucket, and the completed trace ids are
+    pairwise distinct."""
+    import fleet_bench
+
+    from machine_learning_apache_spark_tpu.fleet import FleetRequestFailed
+
+    t0 = time.monotonic()
+    n_requests = 6
+    plan = "torn@wire:rank=1,req=0"
+    translator, texts = fleet_bench.build_translator(tiny=True)
+    knobs = fleet_bench.bench_knobs(tiny=True)
+    markers = os.path.join(workdir, "markers")
+    os.makedirs(markers, exist_ok=True)
+    gang, router = fleet_bench.build_fleet(
+        2, os.path.join(workdir, "fleet"), tiny=True,
+        policy="round_robin", knobs=knobs,
+        extra_env={faults.ENV_PLAN: plan, faults.ENV_MARKER_DIR: markers},
+    )
+    try:
+        payloads = []
+        failures = []
+        for i in range(n_requests):
+            text = texts[i % len(texts)]
+            try:
+                payloads.append(router.submit(
+                    text, tier="interactive", deadline_s=30.0,
+                ))
+            except FleetRequestFailed as e:
+                # The client-side discipline the taxonomy demands: a lost
+                # request is dead; recovery is a fresh submission (new
+                # request id), never a replay of the old one.
+                failures.append({"rank": e.rank, "status": e.status,
+                                 "error": str(e)})
+                payloads.append(router.submit(
+                    text, tier="interactive", deadline_s=30.0,
+                ))
+        drained = _wait_replicas_drained(router)
+        conservation = fleet_bench.conservation_gate(router)
+        router_stats = router.stats()
+    finally:
+        router.stop()
+        gang.stop()
+    fired = sorted(os.listdir(markers)) if os.path.isdir(markers) else []
+    ledger = conservation["router_ledger"]
+    trace_ids = [p.get("trace_id") for p in payloads]
+    return {
+        "scenario": "torn_response_retry",
+        "plan": plan,
+        "fault_fired": fired,
+        "requests": n_requests,
+        "client_retries": len(failures),
+        "failures": failures,
+        "ledger": ledger,
+        "router_retries": router_stats["retries"],
+        "distinct_trace_ids": len(set(trace_ids)),
+        "replicas_drained": drained,
+        "conservation": conservation,
+        "per_replica": router_stats["per_replica"],
+        "wall_seconds": round(time.monotonic() - t0, 2),
+        "ok": (
+            # One-shot fault: fired exactly once, consumed thereafter.
+            sum(1 for f in fired if f.startswith("torn_wire")) == 1
+            and len(failures) == 1
+            and failures[0]["rank"] == 1
+            # One failed (the torn exchange), everything else completed,
+            # and the extra submission is the client's retry — so the
+            # ledger carries n+1 submitted, n completed, 1 failed.
+            and ledger["submitted"] == n_requests + 1
+            and ledger["completed"] == n_requests
+            and ledger["failed"] == 1
+            and ledger["expired"] == 0
+            and ledger["unavailable"] == 0
+            # No silent replay: the router never retried the torn
+            # request (retries counts drain-around continuations).
+            and router_stats["retries"] == 0
+            and ledger["hedged"] == 0
+            and drained
+            and conservation["ok"]
+            and ledger["in_flight"] == 0
+            and len(set(trace_ids)) == n_requests
+            and all(t for t in trace_ids)
+        ),
+    }
+
+
+#: The wire-family scenarios double as the tier-1 ``--smoke`` entry:
+#: fast enough for CI, and they exercise the hedge + cancel + wire-fault
+#: stack end to end over real sockets.
+SMOKE_SCENARIOS = ("straggler_hedge", "torn_response_retry")
+
 SCENARIOS = {
     "elastic_shrink": scenario_elastic_shrink,
     "gang_crash_resume": scenario_gang_crash_resume,
@@ -633,18 +858,34 @@ SCENARIOS = {
     "serving_poison": scenario_serving_poison,
     "fleet_kill_replica": scenario_fleet_kill_replica,
     "preemption_as_scale_down": scenario_preemption_as_scale_down,
+    "straggler_hedge": scenario_straggler_hedge,
+    "torn_response_retry": scenario_torn_response_retry,
 }
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
-    ap.add_argument("--out", default="FAULTS_r05.json")
+    ap.add_argument(
+        "--out", default=None,
+        help="artifact path (full run defaults to FAULTS_r06.json; "
+             "--smoke writes one only when --out is given)",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help=f"tier-1 self-test: just the wire scenarios {SMOKE_SCENARIOS}",
+    )
     ap.add_argument(
         "scenarios", nargs="*", default=None,
         help=f"subset to run (default: all of {sorted(SCENARIOS)})",
     )
     ns = ap.parse_args()
-    names = ns.scenarios or sorted(SCENARIOS)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ns.smoke and ns.scenarios:
+        ap.error("--smoke picks its own scenarios; drop the positional args")
+    names = (
+        list(SMOKE_SCENARIOS) if ns.smoke
+        else (ns.scenarios or sorted(SCENARIOS))
+    )
     unknown = [n for n in names if n not in SCENARIOS]
     if unknown:
         ap.error(f"unknown scenario(s) {unknown}; pick from {sorted(SCENARIOS)}")
@@ -658,14 +899,21 @@ def main() -> int:
 
     report = {
         "artifact": "FAULTS",
-        "round": 5,
+        "round": 6,
+        "smoke": ns.smoke,
         "all_ok": all(r["ok"] for r in results),
         "scenarios": results,
     }
-    with open(ns.out, "w") as f:
-        json.dump(report, f, indent=2)
-        f.write("\n")
-    print(f"wrote {ns.out} (all_ok={report['all_ok']})")
+    out = ns.out if ns.smoke else (ns.out or "FAULTS_r06.json")
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"wrote {out} (all_ok={report['all_ok']})")
+    else:
+        print(json.dumps(
+            {"smoke": True, "all_ok": report["all_ok"]}
+        ), flush=True)
     return 0 if report["all_ok"] else 1
 
 

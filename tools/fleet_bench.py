@@ -102,10 +102,16 @@ def build_fleet(
     policy: str = "affinity",
     key_fn=None,
     knobs: dict | None = None,
+    extra_env: dict | None = None,
+    router_kw: dict | None = None,
 ):
     """Launch an n-replica gang + router over it; blocks until every
     replica scrapes healthy. Returns ``(gang, router)`` — both started;
-    the caller owns teardown (router.stop() then gang.stop())."""
+    the caller owns teardown (router.stop() then gang.stop()).
+    ``extra_env`` reaches every replica process (how the fault drill
+    ships a ``MLSPARK_FAULTS`` wire plan to the ranks); ``router_kw``
+    reaches the router constructor (how the hedge drill flips
+    ``hedge=True`` without touching this driver's environment)."""
     from machine_learning_apache_spark_tpu.fleet import FleetRouter
     from machine_learning_apache_spark_tpu.launcher import ReplicaGang
 
@@ -119,10 +125,11 @@ def build_fleet(
         # Replicas serve observability through the data-plane port; the
         # runner's separate telemetry HTTP server would only burn CPU.
         telemetry_http=None,
-        env={"MLSPARK_TELEMETRY_HTTP": ""},
+        env={"MLSPARK_TELEMETRY_HTTP": "", **(extra_env or {})},
     ).start()
     router = FleetRouter(
         workdir, policy=policy, key_fn=key_fn, scrape_interval=0.25,
+        **(router_kw or {}),
     ).start()
     if not router.wait_for_replicas(n, timeout=240.0):
         router.stop()
@@ -146,10 +153,13 @@ def drive_load(
         FleetRequestFailed,
         FleetUnavailable,
     )
+    from machine_learning_apache_spark_tpu.serving.queue import (
+        DeadlineExceeded,
+    )
 
     lock = threading.Lock()
     counts = {"completed": 0, "rejected": 0, "unavailable": 0,
-              "failed": 0, "tokens": 0}
+              "failed": 0, "expired": 0, "tokens": 0}
     latencies: list[float] = []
     stop_at = time.monotonic() + duration
 
@@ -176,6 +186,9 @@ def drive_load(
             except FleetRequestFailed:
                 with lock:
                     counts["failed"] += 1
+            except DeadlineExceeded:
+                with lock:
+                    counts["expired"] += 1
             n += clients
         return None
 

@@ -35,7 +35,18 @@ the artifact either way.
 scale-down), exiting nonzero if any gate fails. The full run writes
 ``BENCH_SERVE_r07.json`` (``--out`` relocates).
 
-Usage: JAX_PLATFORMS=cpu python tools/fleet_drill.py [--smoke] [--out P]
+``--hedge`` runs the **straggler-hedging bench** instead
+(``BENCH_SERVE_r08.json``): a 2-replica fleet with rank 1 slowed ~10×
+by a sticky wire delay (calibrated from a clean fleet's measured p50),
+driven closed-loop on the interactive tier twice — hedging off, then
+hedging on — under a straggler-blind round-robin policy (least-loaded
+would route around the slow rank and measure nothing). Gates: hedged
+p99 at least ``HEDGE_P99_GATE``× better than unhedged, winning
+responses token-identical to the unfaulted reference, zero recompiles
+on every replica, and both passes' ledgers conserve.
+
+Usage: JAX_PLATFORMS=cpu python tools/fleet_drill.py
+       [--smoke | --hedge] [--out P]
 """
 
 import argparse
@@ -50,17 +61,29 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from fleet_bench import (  # noqa: E402
     bench_knobs,
+    build_fleet,
     build_translator,
     conservation_gate,
     drive_load,
     make_key_fn,
 )
 
+from machine_learning_apache_spark_tpu.utils import faults as _faults  # noqa: E402
 from machine_learning_apache_spark_tpu.utils.sysinfo import host_load  # noqa: E402
 
 #: Required keys on every decision record — the "annotation carries its
 #: inputs" acceptance gate, checked mechanically.
 DECISION_INPUT_KEYS = ("action", "burn", "queue_depth", "live", "target")
+
+#: Hedged interactive p99 must beat unhedged by at least this factor
+#: with one replica slowed by the wire delay.
+HEDGE_P99_GATE = 2.0
+#: The slow rank's injected wire delay targets this multiple of the
+#: clean fleet's measured p50 service time.
+HEDGE_SLOW_FACTOR = 10.0
+#: ...but never less than this (ms): the hedge delay itself sits around
+#: 100-200ms, so a sub-floor straggler would drown the signal in noise.
+HEDGE_DELAY_FLOOR_MS = 800
 
 
 def build_scaled_fleet(
@@ -529,23 +552,237 @@ def run_smoke(out_path: str | None) -> int:
     return 0 if ok else 1
 
 
+def _replica_recompiles(router) -> dict:
+    """Scrape every replica's ``/statusz`` for the zero-recompile
+    verdict — the serving section's ``recompiles_after_warmup``."""
+    import urllib.request
+
+    out = {}
+    for rank, snap in sorted(router._snapshot_source().items()):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{snap.port}/statusz", timeout=10.0
+            ) as resp:
+                payload = json.loads(resp.read().decode("utf-8"))
+            serving = (payload.get("sections") or {}).get("serving") or {}
+            out[rank] = serving.get("recompiles_after_warmup")
+        except Exception as e:  # noqa: BLE001 — report, don't crash the bench
+            out[rank] = f"scrape failed: {e!r}"
+    return out
+
+
+def _wait_fleet_drained(router, timeout: float = 90.0) -> bool:
+    """Router ledger at zero in-flight AND every replica scraped idle —
+    hedge losers keep decoding on the slow rank after their winners
+    already returned, and the conservation gate must not race them."""
+    def _idle() -> bool:
+        if router.ledger()["in_flight"] != 0:
+            return False
+        snaps = (
+            router._scrape.tick() if router._scrape is not None
+            else router._snapshot_source()
+        )
+        return bool(snaps) and all(
+            (s.in_flight or 0) == 0 for s in snaps.values()
+        )
+
+    return _wait(_idle, timeout, poll=0.2)
+
+
+def run_hedge(out_path: str, *, duration: float) -> int:
+    """The BENCH_SERVE_r08 hedging column: interactive p99 with one
+    replica slowed ~10× by a sticky wire delay, hedged vs not, on
+    straggler-blind round-robin. Token parity of the winning responses
+    against an unfaulted reference fleet, zero recompiles, and ledger
+    conservation ride along as gates."""
+    import tempfile
+
+    host = host_load()  # preflight — before any replica spawns
+    translator, texts = build_translator(tiny=True)
+    knobs = bench_knobs(tiny=True)
+    base = tempfile.mkdtemp(prefix="mlspark_hedge_bench_")
+    parity_texts = texts[:12]
+
+    # Phase 0 — clean 2-replica fleet: the unfaulted reference outputs
+    # (greedy decode is deterministic, so these are THE right answers)
+    # and the p50 the slow rank's delay is calibrated against.
+    gang, router = build_fleet(
+        2, os.path.join(base, "calibrate"), tiny=True,
+        policy="round_robin", knobs=knobs,
+    )
+    try:
+        reference = [
+            router.submit(t, tier="interactive", deadline_s=60.0)["text"]
+            for t in parity_texts
+        ]
+        probe = drive_load(
+            router, texts, clients=4, duration=4.0, tier="interactive",
+        )
+    finally:
+        router.stop()
+        gang.stop()
+    p50 = float(probe.get("p50_latency_s") or 0.05)
+    delay_ms = max(HEDGE_DELAY_FLOOR_MS, int(HEDGE_SLOW_FACTOR * p50 * 1000))
+    plan = f"delay@wire:rank=1,ms={delay_ms},sticky=1"
+    print(json.dumps({
+        "phase": "calibrate", "p50_s": round(p50, 4), "delay_ms": delay_ms,
+        "slow_factor": round(delay_ms / 1000.0 / p50, 1) if p50 else None,
+    }), flush=True)
+
+    # Phases 1+2 — same slowed fleet shape, hedging off then on. Fresh
+    # fleet per pass so each owns its ledger and its jit caches.
+    columns = {}
+    for name, hedged in (("unhedged", False), ("hedged", True)):
+        markers = os.path.join(base, f"markers_{name}")
+        os.makedirs(markers, exist_ok=True)
+        gang, router = build_fleet(
+            2, os.path.join(base, name), tiny=True,
+            policy="round_robin", knobs=knobs,
+            extra_env={
+                _faults.ENV_PLAN: plan,
+                _faults.ENV_MARKER_DIR: markers,
+            },
+            router_kw=(
+                dict(
+                    hedge=True, hedge_tiers=("interactive",),
+                    # factor 1.0 converges under a *persistent* straggler
+                    # (the EWMA is fed by hedged totals, so a large factor
+                    # chases its own tail upward until no hedge fires).
+                    hedge_delay_factor=1.0, hedge_min_delay_s=0.05,
+                ) if hedged else {}
+            ),
+        )
+        try:
+            load = drive_load(
+                router, texts, clients=4, duration=duration,
+                tier="interactive",
+            )
+            parity = None
+            if hedged:
+                routed = [
+                    router.submit(
+                        t, tier="interactive", deadline_s=60.0
+                    )["text"]
+                    for t in parity_texts
+                ]
+                mismatches = [
+                    i for i, (a, b) in enumerate(zip(routed, reference))
+                    if a != b
+                ]
+                parity = {
+                    "checked": len(parity_texts),
+                    "identical": not mismatches,
+                    "mismatches": mismatches[:8],
+                }
+            drained = _wait_fleet_drained(router)
+            conservation = conservation_gate(router)
+            recompiles = _replica_recompiles(router)
+            router_stats = router.stats()
+        finally:
+            router.stop()
+            gang.stop()
+        columns[name] = {
+            "hedge": hedged,
+            "load": load,
+            "parity": parity,
+            "drained": drained,
+            "conservation": conservation,
+            "recompiles_after_warmup": recompiles,
+            "ledger": router_stats["ledger"],
+            "per_replica": router_stats["per_replica"],
+            "fault_fired": sorted(os.listdir(markers)),
+        }
+        print(json.dumps({
+            "phase": name,
+            "p99_s": load["p99_latency_s"], "p50_s": load["p50_latency_s"],
+            "hedged": router_stats["ledger"]["hedged"],
+            "cancelled": router_stats["ledger"]["cancelled"],
+        }), flush=True)
+
+    p99_un = columns["unhedged"]["load"]["p99_latency_s"]
+    p99_he = columns["hedged"]["load"]["p99_latency_s"]
+    ratio = round(p99_un / p99_he, 3) if (p99_un and p99_he) else None
+    gates = {
+        "p99_improvement": ratio is not None and ratio >= HEDGE_P99_GATE,
+        "hedges_fired": columns["hedged"]["ledger"]["hedged"] >= 1,
+        "losers_cancelled": columns["hedged"]["ledger"]["cancelled"] >= 1,
+        "token_parity": bool(
+            (columns["hedged"]["parity"] or {}).get("identical")
+        ),
+        "zero_recompiles": all(
+            v == 0
+            for c in columns.values()
+            for v in c["recompiles_after_warmup"].values()
+        ),
+        "conservation": all(
+            c["drained"] and c["conservation"]["ok"]
+            and c["ledger"]["in_flight"] == 0
+            for c in columns.values()
+        ),
+        "fault_armed_both_passes": all(
+            any(f.startswith("delay_wire") for f in c["fault_fired"])
+            for c in columns.values()
+        ),
+    }
+    ok = all(gates.values())
+    artifact = {
+        "bench": "fleet_hedge",
+        "round": 8,
+        "smoke": False,
+        "host_load": host,
+        "contended": host["contended"],
+        "plan": plan,
+        "calibration": {
+            "probe": probe,
+            "p50_s": round(p50, 4),
+            "delay_ms": delay_ms,
+            "slow_factor": (
+                round(delay_ms / 1000.0 / p50, 1) if p50 else None
+            ),
+        },
+        "p99_unhedged_s": p99_un,
+        "p99_hedged_s": p99_he,
+        "p99_ratio": ratio,
+        "gate_ratio": HEDGE_P99_GATE,
+        "columns": columns,
+        "gates": gates,
+        "ok": ok,
+    }
+    with open(out_path, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+    print(json.dumps({"wrote": out_path, "gates": gates, "ok": ok}),
+          flush=True)
+    return 0 if ok else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 self-test: 2→3→2 autoscale cycle")
+    ap.add_argument("--hedge", action="store_true",
+                    help="straggler-hedging bench (BENCH_SERVE_r08)")
     ap.add_argument("--out", default=None,
-                    help="artifact path (full run defaults to "
-                         "BENCH_SERVE_r07.json; smoke writes one only "
+                    help="artifact path (autoscale run defaults to "
+                         "BENCH_SERVE_r07.json, hedge run to "
+                         "BENCH_SERVE_r08.json; smoke writes one only "
                          "when --out is given)")
     ap.add_argument("--burst", type=float, default=180.0,
                     help="max seconds to wait for the 4x scale-up")
     ap.add_argument("--settle", type=float, default=240.0,
                     help="max seconds to wait for the scale-back-down")
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="seconds per closed-loop window (--hedge mode)")
     ns = ap.parse_args(argv)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("MLSPARK_TELEMETRY_HTTP", "")
+    if ns.smoke and ns.hedge:
+        ap.error("--smoke and --hedge are separate entries; pick one")
     if ns.smoke:
         return run_smoke(ns.out)
+    if ns.hedge:
+        return run_hedge(
+            ns.out or "BENCH_SERVE_r08.json", duration=ns.duration,
+        )
     return run_full(
         ns.out or "BENCH_SERVE_r07.json",
         burst_s=ns.burst, settle_s=ns.settle,
